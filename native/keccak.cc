@@ -1,0 +1,111 @@
+// Keccak-256 (Ethereum variant: 0x01 domain padding) — native CPU hot path.
+//
+// The reference client gets its native keccak from the ethash submodule's
+// C implementation (reference: build.zig:94, ethash/lib/keccak/keccak.c) and
+// Zig std's Keccak256 on the client side (reference: src/crypto/hasher.zig:1).
+// This is a from-scratch C++ implementation exposing a C ABI consumed via
+// ctypes (phant_tpu/utils/native.py) — it is the CPU baseline the TPU Pallas
+// kernel (phant_tpu/ops/keccak_jax.py) is benchmarked against.
+
+#include <cstdint>
+#include <cstring>
+#include <cstddef>
+
+namespace {
+
+constexpr uint64_t kRC[24] = {
+    0x0000000000000001ULL, 0x0000000000008082ULL, 0x800000000000808AULL,
+    0x8000000080008000ULL, 0x000000000000808BULL, 0x0000000080000001ULL,
+    0x8000000080008081ULL, 0x8000000000008009ULL, 0x000000000000008AULL,
+    0x0000000000000088ULL, 0x0000000080008009ULL, 0x000000008000000AULL,
+    0x000000008000808BULL, 0x800000000000008BULL, 0x8000000000008089ULL,
+    0x8000000000008003ULL, 0x8000000000008002ULL, 0x8000000000000080ULL,
+    0x000000000000800AULL, 0x800000008000000AULL, 0x8000000080008081ULL,
+    0x8000000000008080ULL, 0x0000000080000001ULL, 0x8000000080008008ULL,
+};
+
+// Rotation offsets for lane A[x + 5y].
+constexpr int kRot[25] = {
+    0,  1,  62, 28, 27,   // y = 0
+    36, 44, 6,  55, 20,   // y = 1
+    3,  10, 43, 25, 39,   // y = 2
+    41, 45, 15, 21, 8,    // y = 3
+    18, 2,  61, 56, 14,   // y = 4
+};
+
+inline uint64_t rotl(uint64_t v, int s) {
+  return s == 0 ? v : (v << s) | (v >> (64 - s));
+}
+
+void keccak_f1600(uint64_t a[25]) {
+  uint64_t b[25];
+  uint64_t c[5], d[5];
+  for (int rnd = 0; rnd < 24; ++rnd) {
+    // theta
+    for (int x = 0; x < 5; ++x)
+      c[x] = a[x] ^ a[x + 5] ^ a[x + 10] ^ a[x + 15] ^ a[x + 20];
+    for (int x = 0; x < 5; ++x)
+      d[x] = c[(x + 4) % 5] ^ rotl(c[(x + 1) % 5], 1);
+    for (int i = 0; i < 25; ++i) a[i] ^= d[i % 5];
+    // rho + pi: B[y + 5*((2x+3y)%5)] = rotl(A[x + 5y])
+    for (int x = 0; x < 5; ++x)
+      for (int y = 0; y < 5; ++y)
+        b[y + 5 * ((2 * x + 3 * y) % 5)] = rotl(a[x + 5 * y], kRot[x + 5 * y]);
+    // chi
+    for (int y = 0; y < 5; ++y)
+      for (int x = 0; x < 5; ++x)
+        a[x + 5 * y] =
+            b[x + 5 * y] ^ (~b[(x + 1) % 5 + 5 * y] & b[(x + 2) % 5 + 5 * y]);
+    // iota
+    a[0] ^= kRC[rnd];
+  }
+}
+
+constexpr size_t kRate = 136;
+
+void keccak256_one(const uint8_t* in, size_t len, uint8_t* out) {
+  uint64_t state[25];
+  std::memset(state, 0, sizeof(state));
+  // absorb full blocks
+  while (len >= kRate) {
+    for (size_t i = 0; i < kRate / 8; ++i) {
+      uint64_t lane;
+      std::memcpy(&lane, in + 8 * i, 8);  // little-endian hosts only
+      state[i] ^= lane;
+    }
+    keccak_f1600(state);
+    in += kRate;
+    len -= kRate;
+  }
+  // final (padded) block
+  uint8_t block[kRate];
+  std::memset(block, 0, sizeof(block));
+  std::memcpy(block, in, len);
+  block[len] ^= 0x01;
+  block[kRate - 1] ^= 0x80;
+  for (size_t i = 0; i < kRate / 8; ++i) {
+    uint64_t lane;
+    std::memcpy(&lane, block + 8 * i, 8);
+    state[i] ^= lane;
+  }
+  keccak_f1600(state);
+  std::memcpy(out, state, 32);
+}
+
+}  // namespace
+
+extern "C" {
+
+void phant_keccak256(const uint8_t* in, size_t len, uint8_t* out) {
+  keccak256_one(in, len, out);
+}
+
+// Batched: payload i is in[offsets[i] .. offsets[i] + lens[i]); out is n*32B.
+void phant_keccak256_batch(const uint8_t* in, const uint64_t* offsets,
+                           const uint32_t* lens, size_t n, uint8_t* out) {
+  for (size_t i = 0; i < n; ++i) {
+    keccak256_one(in + offsets[i], lens[i], out + 32 * i);
+  }
+}
+
+}  // extern "C"

@@ -1,0 +1,38 @@
+"""Account state types (reference: src/state/types.zig:7-50)."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict
+
+from phant_tpu import rlp
+from phant_tpu.crypto.keccak import keccak256, EMPTY_KECCAK
+
+# keccak(rlp(b"")) — root of the empty trie.
+EMPTY_TRIE_ROOT = keccak256(rlp.encode(b""))
+EMPTY_CODE_HASH = EMPTY_KECCAK
+
+
+@dataclass
+class Account:
+    """One account's mutable state: nonce, balance, code, storage."""
+
+    nonce: int = 0
+    balance: int = 0
+    code: bytes = b""
+    storage: Dict[int, int] = field(default_factory=dict)
+
+    def code_hash(self) -> bytes:
+        return keccak256(self.code) if self.code else EMPTY_CODE_HASH
+
+    def is_empty(self) -> bool:
+        """EIP-161 empty: no code, zero nonce, zero balance."""
+        return not self.code and self.nonce == 0 and self.balance == 0
+
+    def copy(self) -> "Account":
+        return Account(
+            nonce=self.nonce,
+            balance=self.balance,
+            code=self.code,
+            storage=dict(self.storage),
+        )

@@ -1,0 +1,167 @@
+"""Block header and block types with fork-aware RLP.
+
+Equivalent surface to the reference (reference: src/types/block.zig:15-135):
+`BlockHeader` carries the post-merge field set plus optional post-Shanghai /
+post-Cancun / post-Prague fields; header RLP truncates trailing optional
+fields by era so pre-fork hashes stay correct (reference: block.zig:51-69).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field, replace
+from typing import List, Optional, Tuple
+
+from phant_tpu import rlp
+from phant_tpu.crypto.keccak import keccak256
+from phant_tpu.types.transaction import (
+    Transaction,
+    decode_tx_from_block_item,
+    encode_tx_for_block,
+)
+from phant_tpu.types.withdrawal import Withdrawal
+
+EMPTY_UNCLE_HASH = keccak256(rlp.encode([]))  # keccak(rlp([]))
+
+
+@dataclass(frozen=True)
+class BlockHeader:
+    parent_hash: bytes = b"\x00" * 32
+    uncle_hash: bytes = EMPTY_UNCLE_HASH
+    fee_recipient: bytes = b"\x00" * 20  # a.k.a. coinbase / miner
+    state_root: bytes = b"\x00" * 32
+    transactions_root: bytes = b"\x00" * 32
+    receipts_root: bytes = b"\x00" * 32
+    logs_bloom: bytes = b"\x00" * 256
+    block_number: int = 0
+    gas_limit: int = 0
+    gas_used: int = 0
+    timestamp: int = 0
+    extra_data: bytes = b""
+    mix_hash: bytes = b"\x00" * 32
+    nonce: bytes = b"\x00" * 8
+    base_fee_per_gas: Optional[int] = None  # EIP-1559 (London)
+    withdrawals_root: Optional[bytes] = None  # EIP-4895 (Shanghai)
+    blob_gas_used: Optional[int] = None  # EIP-4844 (Cancun)
+    excess_blob_gas: Optional[int] = None  # EIP-4844 (Cancun)
+    parent_beacon_block_root: Optional[bytes] = None  # EIP-4788 (Cancun)
+    requests_hash: Optional[bytes] = None  # EIP-7685 (Prague)
+
+    # Headers carry one 32-byte slot that is the PoW mixHash pre-merge and
+    # prevRandao post-merge; `prev_randao` below aliases mix_hash.
+    difficulty: int = 0
+
+    @property
+    def prev_randao(self) -> bytes:
+        return self.mix_hash
+
+    def fields(self) -> list:
+        """Fork-aware field list: trailing optional fields are included only
+        once present (reference: src/types/block.zig:51-69)."""
+        out = [
+            self.parent_hash,
+            self.uncle_hash,
+            self.fee_recipient,
+            self.state_root,
+            self.transactions_root,
+            self.receipts_root,
+            self.logs_bloom,
+            rlp.encode_uint(self.difficulty),
+            rlp.encode_uint(self.block_number),
+            rlp.encode_uint(self.gas_limit),
+            rlp.encode_uint(self.gas_used),
+            rlp.encode_uint(self.timestamp),
+            self.extra_data,
+            self.mix_hash,
+            self.nonce,
+        ]
+        optionals = [
+            None if self.base_fee_per_gas is None else rlp.encode_uint(self.base_fee_per_gas),
+            self.withdrawals_root,
+            None if self.blob_gas_used is None else rlp.encode_uint(self.blob_gas_used),
+            None if self.excess_blob_gas is None else rlp.encode_uint(self.excess_blob_gas),
+            self.parent_beacon_block_root,
+            self.requests_hash,
+        ]
+        for opt in optionals:
+            if opt is None:
+                break
+            out.append(opt)
+        return out
+
+    def encode(self) -> bytes:
+        return rlp.encode(self.fields())
+
+    def hash(self) -> bytes:
+        """Canonical header hash = keccak(rlp(header))
+        (reference: src/common/rlp.zig:14-22 via blockchain.zig:135)."""
+        return keccak256(self.encode())
+
+    @classmethod
+    def from_rlp_list(cls, items: list) -> "BlockHeader":
+        if len(items) < 15:
+            raise rlp.DecodeError(f"header wants >=15 fields, got {len(items)}")
+        kwargs = dict(
+            parent_hash=bytes(items[0]),
+            uncle_hash=bytes(items[1]),
+            fee_recipient=bytes(items[2]),
+            state_root=bytes(items[3]),
+            transactions_root=bytes(items[4]),
+            receipts_root=bytes(items[5]),
+            logs_bloom=bytes(items[6]),
+            difficulty=rlp.decode_uint(items[7]),
+            block_number=rlp.decode_uint(items[8]),
+            gas_limit=rlp.decode_uint(items[9]),
+            gas_used=rlp.decode_uint(items[10]),
+            timestamp=rlp.decode_uint(items[11]),
+            extra_data=bytes(items[12]),
+            mix_hash=bytes(items[13]),
+            nonce=bytes(items[14]),
+        )
+        if len(items) > 15:
+            kwargs["base_fee_per_gas"] = rlp.decode_uint(items[15])
+        if len(items) > 16:
+            kwargs["withdrawals_root"] = bytes(items[16])
+        if len(items) > 17:
+            kwargs["blob_gas_used"] = rlp.decode_uint(items[17])
+        if len(items) > 18:
+            kwargs["excess_blob_gas"] = rlp.decode_uint(items[18])
+        if len(items) > 19:
+            kwargs["parent_beacon_block_root"] = bytes(items[19])
+        if len(items) > 20:
+            kwargs["requests_hash"] = bytes(items[20])
+        return cls(**kwargs)
+
+
+@dataclass(frozen=True)
+class Block:
+    header: BlockHeader
+    transactions: Tuple[Transaction, ...] = ()
+    uncles: Tuple[BlockHeader, ...] = ()
+    withdrawals: Optional[Tuple[Withdrawal, ...]] = None
+
+    def fields(self) -> list:
+        out = [
+            self.header.fields(),
+            [encode_tx_for_block(tx) for tx in self.transactions],
+            [u.fields() for u in self.uncles],
+        ]
+        if self.withdrawals is not None:
+            out.append([w.fields() for w in self.withdrawals])
+        return out
+
+    def encode(self) -> bytes:
+        return rlp.encode(self.fields())
+
+    @classmethod
+    def decode(cls, data: bytes) -> "Block":
+        """RLP block decode (reference: src/types/block.zig:78-82)."""
+        items = rlp.decode(data)
+        if not isinstance(items, list) or len(items) < 3:
+            raise rlp.DecodeError("block wants [header, txs, uncles, withdrawals?]")
+        header = BlockHeader.from_rlp_list(items[0])
+        txs = tuple(decode_tx_from_block_item(t) for t in items[1])
+        uncles = tuple(BlockHeader.from_rlp_list(u) for u in items[2])
+        withdrawals = None
+        if len(items) > 3:
+            withdrawals = tuple(Withdrawal.from_rlp_list(w) for w in items[3])
+        return cls(header=header, transactions=txs, uncles=uncles, withdrawals=withdrawals)

@@ -1,0 +1,74 @@
+"""Receipts, logs, and the 2048-bit logs bloom.
+
+Equivalent surface to the reference (reference: src/types/receipt.zig:13-70):
+receipt RLP {status, cumulative_gas_used, bloom, logs} with EIP-2718 type
+prefix for typed txs, and the yellow-paper M3:2048 bloom — 3 bit positions
+taken from the first three 16-bit big-endian words of keccak256(entry).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import List, Sequence, Tuple
+
+from phant_tpu import rlp
+from phant_tpu.crypto.keccak import keccak256
+
+BLOOM_BYTES = 256
+
+
+@dataclass(frozen=True)
+class Log:
+    address: bytes  # 20 bytes
+    topics: Tuple[bytes, ...]  # each 32 bytes
+    data: bytes
+
+    def fields(self) -> list:
+        return [self.address, [t for t in self.topics], self.data]
+
+
+def _bloom_add(bloom: bytearray, entry: bytes) -> None:
+    h = keccak256(entry)
+    for i in (0, 2, 4):
+        bit = ((h[i] << 8) | h[i + 1]) & 0x7FF  # low 11 bits => 0..2047
+        byte_index = BLOOM_BYTES - 1 - bit // 8
+        bloom[byte_index] |= 1 << (bit % 8)
+
+
+def logs_bloom(logs: Sequence[Log]) -> bytes:
+    """Bloom over all log addresses and topics
+    (reference: src/types/receipt.zig:50-63)."""
+    bloom = bytearray(BLOOM_BYTES)
+    for log in logs:
+        _bloom_add(bloom, log.address)
+        for topic in log.topics:
+            _bloom_add(bloom, topic)
+    return bytes(bloom)
+
+
+@dataclass(frozen=True)
+class Receipt:
+    tx_type: int
+    succeeded: bool
+    cumulative_gas_used: int
+    logs: Tuple[Log, ...]
+    bloom: bytes = field(default=b"")
+
+    def __post_init__(self):
+        if not self.bloom:
+            object.__setattr__(self, "bloom", logs_bloom(self.logs))
+
+    def fields(self) -> list:
+        return [
+            b"\x01" if self.succeeded else b"",
+            rlp.encode_uint(self.cumulative_gas_used),
+            self.bloom,
+            [log.fields() for log in self.logs],
+        ]
+
+    def encode(self) -> bytes:
+        """EIP-2718: typed receipts get the tx-type prefix byte."""
+        payload = rlp.encode(self.fields())
+        if self.tx_type == 0:
+            return payload
+        return bytes([self.tx_type]) + payload

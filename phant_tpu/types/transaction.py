@@ -1,0 +1,286 @@
+"""Ethereum transaction types: legacy, EIP-2930 access-list, EIP-1559 fee-market.
+
+Equivalent surface to the reference's tagged union (reference:
+src/types/transaction.zig:10-273): EIP-2718 typed envelope decode/encode,
+per-type keccak tx hash, and uniform getters. Implemented as dataclasses with
+a small dispatch table instead of a tagged union.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import List, Optional, Sequence, Tuple, Union
+
+from phant_tpu import rlp
+from phant_tpu.crypto.keccak import keccak256
+
+AccessListEntry = Tuple[bytes, Tuple[bytes, ...]]  # (address20, (storage_key32, ...))
+
+TX_TYPE_LEGACY = 0x00
+TX_TYPE_ACCESS_LIST = 0x01
+TX_TYPE_FEE_MARKET = 0x02
+
+
+def _encode_access_list(access_list: Sequence[AccessListEntry]) -> list:
+    return [[addr, [k for k in keys]] for addr, keys in access_list]
+
+
+def _decode_access_list(item) -> Tuple[AccessListEntry, ...]:
+    out = []
+    for entry in item:
+        addr, keys = entry
+        out.append((bytes(addr), tuple(bytes(k) for k in keys)))
+    return tuple(out)
+
+
+@dataclass(frozen=True)
+class LegacyTx:
+    """Pre-EIP-2718 transaction (reference: src/types/transaction.zig:144-202)."""
+
+    nonce: int
+    gas_price: int
+    gas_limit: int
+    to: Optional[bytes]  # None => contract creation
+    value: int
+    data: bytes
+    v: int
+    r: int
+    s: int
+
+    tx_type: int = field(default=TX_TYPE_LEGACY, init=False, repr=False)
+
+    def fields(self) -> list:
+        return [
+            rlp.encode_uint(self.nonce),
+            rlp.encode_uint(self.gas_price),
+            rlp.encode_uint(self.gas_limit),
+            self.to if self.to is not None else b"",
+            rlp.encode_uint(self.value),
+            self.data,
+            rlp.encode_uint(self.v),
+            rlp.encode_uint(self.r),
+            rlp.encode_uint(self.s),
+        ]
+
+    def encode(self) -> bytes:
+        return rlp.encode(self.fields())
+
+    def hash(self) -> bytes:
+        return keccak256(self.encode())
+
+    # EIP-155: chain id recoverable from v (reference: transaction.zig:195-202)
+    def chain_id(self) -> Optional[int]:
+        if self.v in (27, 28):
+            return None
+        return (self.v - 35) // 2
+
+    @classmethod
+    def from_rlp_list(cls, items: list) -> "LegacyTx":
+        if len(items) != 9:
+            raise rlp.DecodeError(f"legacy tx wants 9 fields, got {len(items)}")
+        to = bytes(items[3])
+        return cls(
+            nonce=rlp.decode_uint(items[0]),
+            gas_price=rlp.decode_uint(items[1]),
+            gas_limit=rlp.decode_uint(items[2]),
+            to=to if to else None,
+            value=rlp.decode_uint(items[4]),
+            data=bytes(items[5]),
+            v=rlp.decode_uint(items[6]),
+            r=rlp.decode_uint(items[7]),
+            s=rlp.decode_uint(items[8]),
+        )
+
+
+@dataclass(frozen=True)
+class AccessListTx:
+    """EIP-2930 typed tx 0x01 (reference: src/types/transaction.zig:204-236)."""
+
+    chain_id_val: int
+    nonce: int
+    gas_price: int
+    gas_limit: int
+    to: Optional[bytes]
+    value: int
+    data: bytes
+    access_list: Tuple[AccessListEntry, ...]
+    y_parity: int
+    r: int
+    s: int
+
+    tx_type: int = field(default=TX_TYPE_ACCESS_LIST, init=False, repr=False)
+
+    def fields(self) -> list:
+        return [
+            rlp.encode_uint(self.chain_id_val),
+            rlp.encode_uint(self.nonce),
+            rlp.encode_uint(self.gas_price),
+            rlp.encode_uint(self.gas_limit),
+            self.to if self.to is not None else b"",
+            rlp.encode_uint(self.value),
+            self.data,
+            _encode_access_list(self.access_list),
+            rlp.encode_uint(self.y_parity),
+            rlp.encode_uint(self.r),
+            rlp.encode_uint(self.s),
+        ]
+
+    def encode(self) -> bytes:
+        return bytes([TX_TYPE_ACCESS_LIST]) + rlp.encode(self.fields())
+
+    def hash(self) -> bytes:
+        return keccak256(self.encode())
+
+    def chain_id(self) -> Optional[int]:
+        return self.chain_id_val
+
+    @classmethod
+    def from_rlp_list(cls, items: list) -> "AccessListTx":
+        if len(items) != 11:
+            raise rlp.DecodeError(f"2930 tx wants 11 fields, got {len(items)}")
+        to = bytes(items[4])
+        return cls(
+            chain_id_val=rlp.decode_uint(items[0]),
+            nonce=rlp.decode_uint(items[1]),
+            gas_price=rlp.decode_uint(items[2]),
+            gas_limit=rlp.decode_uint(items[3]),
+            to=to if to else None,
+            value=rlp.decode_uint(items[5]),
+            data=bytes(items[6]),
+            access_list=_decode_access_list(items[7]),
+            y_parity=rlp.decode_uint(items[8]),
+            r=rlp.decode_uint(items[9]),
+            s=rlp.decode_uint(items[10]),
+        )
+
+
+@dataclass(frozen=True)
+class FeeMarketTx:
+    """EIP-1559 typed tx 0x02 (reference: src/types/transaction.zig:238-273)."""
+
+    chain_id_val: int
+    nonce: int
+    max_priority_fee_per_gas: int
+    max_fee_per_gas: int
+    gas_limit: int
+    to: Optional[bytes]
+    value: int
+    data: bytes
+    access_list: Tuple[AccessListEntry, ...]
+    y_parity: int
+    r: int
+    s: int
+
+    tx_type: int = field(default=TX_TYPE_FEE_MARKET, init=False, repr=False)
+
+    def fields(self) -> list:
+        return [
+            rlp.encode_uint(self.chain_id_val),
+            rlp.encode_uint(self.nonce),
+            rlp.encode_uint(self.max_priority_fee_per_gas),
+            rlp.encode_uint(self.max_fee_per_gas),
+            rlp.encode_uint(self.gas_limit),
+            self.to if self.to is not None else b"",
+            rlp.encode_uint(self.value),
+            self.data,
+            _encode_access_list(self.access_list),
+            rlp.encode_uint(self.y_parity),
+            rlp.encode_uint(self.r),
+            rlp.encode_uint(self.s),
+        ]
+
+    def encode(self) -> bytes:
+        return bytes([TX_TYPE_FEE_MARKET]) + rlp.encode(self.fields())
+
+    def hash(self) -> bytes:
+        return keccak256(self.encode())
+
+    def chain_id(self) -> Optional[int]:
+        return self.chain_id_val
+
+    @classmethod
+    def from_rlp_list(cls, items: list) -> "FeeMarketTx":
+        if len(items) != 12:
+            raise rlp.DecodeError(f"1559 tx wants 12 fields, got {len(items)}")
+        to = bytes(items[5])
+        return cls(
+            chain_id_val=rlp.decode_uint(items[0]),
+            nonce=rlp.decode_uint(items[1]),
+            max_priority_fee_per_gas=rlp.decode_uint(items[2]),
+            max_fee_per_gas=rlp.decode_uint(items[3]),
+            gas_limit=rlp.decode_uint(items[4]),
+            to=to if to else None,
+            value=rlp.decode_uint(items[6]),
+            data=bytes(items[7]),
+            access_list=_decode_access_list(items[8]),
+            y_parity=rlp.decode_uint(items[9]),
+            r=rlp.decode_uint(items[10]),
+            s=rlp.decode_uint(items[11]),
+        )
+
+
+Transaction = Union[LegacyTx, AccessListTx, FeeMarketTx]
+
+
+def decode_tx(data: bytes) -> Transaction:
+    """EIP-2718 envelope decode (reference: src/types/transaction.zig:28-44)."""
+    if not data:
+        raise rlp.DecodeError("empty transaction bytes")
+    first = data[0]
+    if first > 0x7F:  # RLP list prefix => legacy tx
+        items = rlp.decode(data)
+        if not isinstance(items, list):
+            raise rlp.DecodeError("legacy tx must be an RLP list")
+        return LegacyTx.from_rlp_list(items)
+    if first == TX_TYPE_ACCESS_LIST:
+        items = rlp.decode(data[1:])
+        if not isinstance(items, list):
+            raise rlp.DecodeError("typed tx payload must be an RLP list")
+        return AccessListTx.from_rlp_list(items)
+    if first == TX_TYPE_FEE_MARKET:
+        items = rlp.decode(data[1:])
+        if not isinstance(items, list):
+            raise rlp.DecodeError("typed tx payload must be an RLP list")
+        return FeeMarketTx.from_rlp_list(items)
+    raise rlp.DecodeError(f"unsupported tx type 0x{first:02x}")
+
+
+def decode_tx_from_block_item(item) -> Transaction:
+    """Decode a tx embedded in a block-body RLP list: legacy txs appear as
+    nested lists, typed txs as opaque byte strings (reference:
+    src/types/transaction.zig:65-77)."""
+    if isinstance(item, list):
+        return LegacyTx.from_rlp_list(item)
+    return decode_tx(bytes(item))
+
+
+def encode_tx_for_block(tx: Transaction):
+    """Inverse of decode_tx_from_block_item: legacy txs embed as RLP lists,
+    typed txs as byte strings."""
+    if isinstance(tx, LegacyTx):
+        return tx.fields()
+    return tx.encode()
+
+
+# --- uniform getters (reference: src/types/transaction.zig:87-141) ---
+
+
+def effective_gas_price(tx: Transaction, base_fee: int) -> int:
+    """EIP-1559 effective price; legacy/2930 are flat gas_price
+    (reference: src/blockchain/blockchain.zig:276-287)."""
+    if isinstance(tx, FeeMarketTx):
+        priority = min(tx.max_priority_fee_per_gas, tx.max_fee_per_gas - base_fee)
+        return priority + base_fee
+    return tx.gas_price
+
+
+def max_fee_per_gas(tx: Transaction) -> int:
+    if isinstance(tx, FeeMarketTx):
+        return tx.max_fee_per_gas
+    return tx.gas_price
+
+
+def access_list_of(tx: Transaction) -> Tuple[AccessListEntry, ...]:
+    if isinstance(tx, LegacyTx):
+        return ()
+    return tx.access_list

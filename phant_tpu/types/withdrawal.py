@@ -1,0 +1,39 @@
+"""EIP-4895 withdrawal (reference: src/types/withdrawal.zig:7-21)."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from phant_tpu import rlp
+
+GWEI = 10**9
+
+
+@dataclass(frozen=True)
+class Withdrawal:
+    index: int
+    validator_index: int
+    address: bytes  # 20 bytes
+    amount: int  # in gwei; credited as amount * 10**9 wei
+
+    def fields(self) -> list:
+        return [
+            rlp.encode_uint(self.index),
+            rlp.encode_uint(self.validator_index),
+            self.address,
+            rlp.encode_uint(self.amount),
+        ]
+
+    def encode(self) -> bytes:
+        return rlp.encode(self.fields())
+
+    @classmethod
+    def from_rlp_list(cls, items: list) -> "Withdrawal":
+        if len(items) != 4:
+            raise rlp.DecodeError(f"withdrawal wants 4 fields, got {len(items)}")
+        return cls(
+            index=rlp.decode_uint(items[0]),
+            validator_index=rlp.decode_uint(items[1]),
+            address=bytes(items[2]),
+            amount=rlp.decode_uint(items[3]),
+        )

@@ -1,0 +1,56 @@
+"""Hex helpers for 0x-prefixed JSON encodings (Engine API, chainspecs, fixtures).
+
+Equivalent surface to the reference's hex utilities
+(reference: src/common/hexutils.zig:9-77).
+"""
+
+from __future__ import annotations
+
+__all__ = [
+    "hex_to_bytes",
+    "hex_to_int",
+    "hex_to_address",
+    "hex_to_hash",
+    "int_to_hex",
+    "bytes_to_hex",
+]
+
+
+def hex_to_bytes(value: str) -> bytes:
+    """Decode a 0x-prefixed (or bare) hex string; odd-length inputs are
+    left-padded with one zero nibble (fixture JSONs contain e.g. "0x1")."""
+    if value.startswith(("0x", "0X")):
+        value = value[2:]
+    if len(value) % 2:
+        value = "0" + value
+    return bytes.fromhex(value)
+
+
+def hex_to_int(value: str) -> int:
+    if isinstance(value, int):
+        return value
+    if value in ("0x", ""):
+        return 0
+    return int(value, 16)
+
+
+def hex_to_address(value: str) -> bytes:
+    raw = hex_to_bytes(value)
+    if len(raw) > 20:
+        raise ValueError(f"address too long: {value}")
+    return raw.rjust(20, b"\x00")
+
+
+def hex_to_hash(value: str) -> bytes:
+    raw = hex_to_bytes(value)
+    if len(raw) > 32:
+        raise ValueError(f"hash too long: {value}")
+    return raw.rjust(32, b"\x00")
+
+
+def int_to_hex(value: int) -> str:
+    return hex(value)
+
+
+def bytes_to_hex(value: bytes) -> str:
+    return "0x" + value.hex()

@@ -1,0 +1,112 @@
+"""Loader/builder for the native C++ runtime (native/*.cc -> libphant_native.so).
+
+The reference builds its native components (ethash keccak, evmone, secp256k1)
+as static libs inside build.zig (reference: build.zig:79-135). Here the native
+runtime is a single shared library compiled on demand with g++ and loaded via
+ctypes; if the toolchain is unavailable the pure-Python fallbacks take over.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from pathlib import Path
+from typing import List, Optional, Sequence
+
+_REPO_ROOT = Path(__file__).resolve().parent.parent.parent
+_NATIVE_DIR = _REPO_ROOT / "native"
+_BUILD_DIR = _REPO_ROOT / "build"
+_LIB_PATH = _BUILD_DIR / "libphant_native.so"
+
+_lock = threading.Lock()
+_loaded: Optional["NativeLib"] = None
+_load_failed = False
+
+
+def _sources() -> List[Path]:
+    return sorted(_NATIVE_DIR.glob("*.cc"))
+
+
+def _needs_rebuild() -> bool:
+    if not _LIB_PATH.exists():
+        return True
+    lib_mtime = _LIB_PATH.stat().st_mtime
+    return any(src.stat().st_mtime > lib_mtime for src in _sources())
+
+
+def build_native(verbose: bool = False) -> Path:
+    """Compile native/*.cc into build/libphant_native.so (idempotent)."""
+    _BUILD_DIR.mkdir(exist_ok=True)
+    if _needs_rebuild():
+        cmd = [
+            "g++", "-O3", "-march=native", "-std=c++20", "-shared", "-fPIC",
+            "-fno-exceptions", "-fno-rtti", "-Wall",
+            *(str(s) for s in _sources()),
+            "-o", str(_LIB_PATH),
+        ]
+        if verbose:
+            print("[phant_tpu.native]", " ".join(cmd))
+        subprocess.run(cmd, check=True, capture_output=not verbose)
+    return _LIB_PATH
+
+
+class NativeLib:
+    """ctypes facade over the native runtime."""
+
+    def __init__(self, lib: ctypes.CDLL):
+        self._lib = lib
+        lib.phant_keccak256.argtypes = [
+            ctypes.c_char_p, ctypes.c_size_t, ctypes.c_char_p,
+        ]
+        lib.phant_keccak256.restype = None
+        lib.phant_keccak256_batch.argtypes = [
+            ctypes.c_char_p,
+            ctypes.POINTER(ctypes.c_uint64),
+            ctypes.POINTER(ctypes.c_uint32),
+            ctypes.c_size_t,
+            ctypes.c_char_p,
+        ]
+        lib.phant_keccak256_batch.restype = None
+
+    def keccak256(self, data: bytes) -> bytes:
+        out = ctypes.create_string_buffer(32)
+        self._lib.phant_keccak256(data, len(data), out)
+        return out.raw
+
+    def keccak256_batch(self, payloads: Sequence[bytes]) -> List[bytes]:
+        n = len(payloads)
+        if n == 0:
+            return []
+        blob = b"".join(payloads)
+        offsets = (ctypes.c_uint64 * n)()
+        lens = (ctypes.c_uint32 * n)()
+        pos = 0
+        for i, p in enumerate(payloads):
+            offsets[i] = pos
+            lens[i] = len(p)
+            pos += len(p)
+        out = ctypes.create_string_buffer(32 * n)
+        self._lib.phant_keccak256_batch(blob, offsets, lens, n, out)
+        raw = out.raw
+        return [raw[32 * i : 32 * i + 32] for i in range(n)]
+
+
+def load_native() -> Optional[NativeLib]:
+    """Build (if stale) and load the native runtime; None if unavailable."""
+    global _loaded, _load_failed
+    if _loaded is not None:
+        return _loaded
+    if _load_failed or os.environ.get("PHANT_NO_NATIVE"):
+        return None
+    with _lock:
+        if _loaded is not None:
+            return _loaded
+        try:
+            path = build_native()
+            _loaded = NativeLib(ctypes.CDLL(str(path)))
+        except Exception:
+            _load_failed = True
+            return None
+    return _loaded

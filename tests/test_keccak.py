@@ -1,0 +1,49 @@
+"""Keccak-256 golden vectors + native/python differential tests."""
+
+import os
+
+import pytest
+
+from phant_tpu.crypto import keccak
+
+
+VECTORS = [
+    (b"", "c5d2460186f7233c927e7db2dcc703c0e500b653ca82273b7bfad8045d85a470"),
+    (b"abc", "4e03657aea45a94fc7d47ba826c8d667c0d1e6e33a64a036ec44f58fa12d6c45"),
+    (b"testing", "5f16f4c7f149ac4f9510d9cf8cf384038ad348b3bcdc01915f95de12df9d1b02"),
+]
+
+
+@pytest.mark.parametrize("data,expected", VECTORS)
+def test_golden_python(data, expected):
+    assert keccak.keccak256_python(data).hex() == expected
+
+
+@pytest.mark.parametrize("data,expected", VECTORS)
+def test_golden_default_backend(data, expected):
+    assert keccak.keccak256(data).hex() == expected
+
+
+def test_with_prefix():
+    assert keccak.keccak256_with_prefix(0x02, b"abc") == keccak.keccak256(b"\x02abc")
+
+
+@pytest.mark.parametrize("n", [0, 1, 31, 32, 55, 135, 136, 137, 271, 272, 576, 1000])
+def test_native_vs_python_lengths(n):
+    data = os.urandom(n)
+    assert keccak.keccak256(data) == keccak.keccak256_python(data)
+
+
+def test_batch_matches_scalar():
+    payloads = [os.urandom(n) for n in (0, 5, 32, 100, 136, 300, 576)]
+    out = keccak.keccak256_batch(payloads)
+    assert out == [keccak.keccak256_python(p) for p in payloads]
+
+
+def test_native_loaded():
+    # The environment ships g++; the native path must actually be in use.
+    if os.environ.get("PHANT_NO_NATIVE"):
+        pytest.skip("native disabled by env")
+    from phant_tpu.utils.native import load_native
+
+    assert load_native() is not None

@@ -1,0 +1,69 @@
+"""RLP codec tests — canonical vectors from the yellow paper / ethereum wiki."""
+
+import pytest
+
+from phant_tpu import rlp
+
+
+CASES = [
+    (b"", b"\x80"),
+    (b"\x00", b"\x00"),
+    (b"\x0f", b"\x0f"),
+    (b"\x7f", b"\x7f"),
+    (b"\x80", b"\x81\x80"),
+    (b"dog", b"\x83dog"),
+    ([], b"\xc0"),
+    ([b"cat", b"dog"], b"\xc8\x83cat\x83dog"),
+    (b"Lorem ipsum dolor sit amet, consectetur adipisicing elit",
+     b"\xb8\x38Lorem ipsum dolor sit amet, consectetur adipisicing elit"),
+]
+
+
+@pytest.mark.parametrize("item,expected", CASES)
+def test_encode_vectors(item, expected):
+    assert rlp.encode(item) == expected
+
+
+@pytest.mark.parametrize("item,expected", CASES)
+def test_roundtrip(item, expected):
+    assert rlp.decode(expected) == item
+
+
+def test_nested_list():
+    # set-theoretic representation of three: [ [], [[]], [ [], [[]] ] ]
+    item = [[], [[]], [[], [[]]]]
+    enc = rlp.encode(item)
+    assert enc == bytes.fromhex("c7c0c1c0c3c0c1c0")
+    assert rlp.decode(enc) == item
+
+
+def test_long_list():
+    items = [b"x" * 10 for _ in range(10)]
+    enc = rlp.encode(items)
+    assert enc[0] == 0xF8  # long list, 1 length byte
+    assert rlp.decode(enc) == items
+
+
+def test_encode_uint():
+    assert rlp.encode_uint(0) == b""
+    assert rlp.encode_uint(15) == b"\x0f"
+    assert rlp.encode_uint(1024) == b"\x04\x00"
+    assert rlp.encode(0) == b"\x80"
+    assert rlp.encode(15) == b"\x0f"
+    assert rlp.encode(1024) == b"\x82\x04\x00"
+
+
+def test_non_canonical_rejected():
+    with pytest.raises(rlp.DecodeError):
+        rlp.decode(b"\x81\x05")  # single byte <0x80 must encode as itself
+    with pytest.raises(rlp.DecodeError):
+        rlp.decode(b"\xb8\x05hello")  # <=55 bytes must use short form
+    with pytest.raises(rlp.DecodeError):
+        rlp.decode(b"\x83do")  # truncated
+    with pytest.raises(rlp.DecodeError):
+        rlp.decode(rlp.encode(b"dog") + b"x")  # trailing bytes
+
+
+def test_decode_uint_leading_zero():
+    with pytest.raises(rlp.DecodeError):
+        rlp.decode_uint(b"\x00\x01")

@@ -1,0 +1,132 @@
+"""Type-layer tests: tx/block RLP roundtrips, header hashing, receipts bloom."""
+
+from phant_tpu import rlp
+from phant_tpu.types.block import Block, BlockHeader, EMPTY_UNCLE_HASH
+from phant_tpu.types.receipt import Log, Receipt, logs_bloom
+from phant_tpu.types.transaction import (
+    AccessListTx,
+    FeeMarketTx,
+    LegacyTx,
+    decode_tx,
+    decode_tx_from_block_item,
+    effective_gas_price,
+    encode_tx_for_block,
+)
+from phant_tpu.types.withdrawal import Withdrawal
+
+
+def test_empty_uncle_hash():
+    assert EMPTY_UNCLE_HASH.hex() == (
+        "1dcc4de8dec75d7aab85b567b6ccd41ad312451b948a7413f0a142fd40d49347"
+    )
+
+
+def _legacy():
+    return LegacyTx(
+        nonce=9, gas_price=20 * 10**9, gas_limit=21000,
+        to=bytes.fromhex("3535353535353535353535353535353535353535"),
+        value=10**18, data=b"", v=37,
+        r=0x28EF61340BD939BC2195FE537567866003E1A15D3C71FF63E1590620AA636276,
+        s=0x67CBE9D8997F761AECB703304B3800CCF555C9F3DC64214B297FB1966A3B6D83,
+    )
+
+
+def test_legacy_roundtrip_and_chain_id():
+    tx = _legacy()
+    assert decode_tx(tx.encode()) == tx
+    assert tx.chain_id() == 1  # EIP-155: v=37 -> chain id 1
+
+
+def test_eip155_example_signing_hash():
+    # The canonical EIP-155 example: signing data for nonce=9 tx on chain 1.
+    tx = _legacy()
+    from phant_tpu.crypto.keccak import keccak256
+
+    payload = rlp.encode([
+        rlp.encode_uint(9), rlp.encode_uint(20 * 10**9), rlp.encode_uint(21000),
+        tx.to, rlp.encode_uint(10**18), b"", rlp.encode_uint(1), b"", b"",
+    ])
+    assert keccak256(payload).hex() == (
+        "daf5a779ae972f972197303d7b574746c7ef83eadac0f2791ad23db92e4c8e53"
+    )
+
+
+def test_typed_tx_roundtrip():
+    al = ((b"\x11" * 20, (b"\x22" * 32, b"\x33" * 32)),)
+    tx1 = AccessListTx(
+        chain_id_val=1, nonce=3, gas_price=5, gas_limit=100000,
+        to=b"\x44" * 20, value=7, data=b"\xde\xad", access_list=al,
+        y_parity=1, r=123, s=456,
+    )
+    assert decode_tx(tx1.encode()) == tx1
+    assert tx1.encode()[0] == 0x01
+
+    tx2 = FeeMarketTx(
+        chain_id_val=1, nonce=0, max_priority_fee_per_gas=2, max_fee_per_gas=90,
+        gas_limit=30000, to=None, value=0, data=b"\x60\x00", access_list=(),
+        y_parity=0, r=9, s=10,
+    )
+    assert decode_tx(tx2.encode()) == tx2
+    assert tx2.encode()[0] == 0x02
+
+
+def test_block_roundtrip_with_withdrawals():
+    header = BlockHeader(
+        parent_hash=b"\x01" * 32, state_root=b"\x02" * 32,
+        transactions_root=b"\x03" * 32, receipts_root=b"\x04" * 32,
+        block_number=17_000_000, gas_limit=30_000_000, gas_used=12345,
+        timestamp=1681338455, base_fee_per_gas=10**9,
+        withdrawals_root=b"\x05" * 32,
+    )
+    block = Block(
+        header=header,
+        transactions=(_legacy(),),
+        withdrawals=(Withdrawal(1, 2, b"\x06" * 20, 3_000_000),),
+    )
+    decoded = Block.decode(block.encode())
+    assert decoded == block
+    assert decoded.header.hash() == header.hash()
+
+
+def test_header_optional_truncation():
+    pre_london = BlockHeader(block_number=1)  # no base fee
+    assert len(pre_london.fields()) == 15
+    london = BlockHeader(block_number=1, base_fee_per_gas=7)
+    assert len(london.fields()) == 16
+    shanghai = BlockHeader(base_fee_per_gas=7, withdrawals_root=b"\x00" * 32)
+    assert len(shanghai.fields()) == 17
+
+
+def test_typed_tx_in_block_is_bytestring():
+    tx = FeeMarketTx(
+        chain_id_val=1, nonce=0, max_priority_fee_per_gas=2, max_fee_per_gas=90,
+        gas_limit=30000, to=b"\x44" * 20, value=0, data=b"", access_list=(),
+        y_parity=0, r=9, s=10,
+    )
+    item = encode_tx_for_block(tx)
+    assert isinstance(item, bytes)
+    assert decode_tx_from_block_item(item) == tx
+
+
+def test_effective_gas_price():
+    tx = FeeMarketTx(
+        chain_id_val=1, nonce=0, max_priority_fee_per_gas=2, max_fee_per_gas=10,
+        gas_limit=21000, to=b"\x00" * 20, value=0, data=b"", access_list=(),
+        y_parity=0, r=1, s=1,
+    )
+    assert effective_gas_price(tx, base_fee=5) == 7  # priority 2 fits
+    assert effective_gas_price(tx, base_fee=9) == 10  # clamped to max_fee
+
+
+def test_bloom_bits():
+    log = Log(address=b"\xaa" * 20, topics=(b"\xbb" * 32,), data=b"")
+    bloom = logs_bloom([log])
+    assert len(bloom) == 256
+    assert sum(bin(b).count("1") for b in bloom) <= 6  # ≤3 bits per entry, 2 entries
+    assert any(bloom)
+
+    r = Receipt(tx_type=2, succeeded=True, cumulative_gas_used=21000, logs=(log,))
+    assert r.encode()[0] == 0x02
+    r0 = Receipt(tx_type=0, succeeded=False, cumulative_gas_used=1, logs=())
+    items = rlp.decode(r0.encode())
+    assert items[0] == b""  # failed status encodes as empty string
