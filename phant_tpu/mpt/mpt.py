@@ -1,0 +1,249 @@
+"""Merkle Patricia Trie: construction, root computation, node enumeration.
+
+Equivalent surface to the reference (reference: src/mpt/mpt.zig:13-314):
+`keyval` pairs -> trie -> keccak root, with hex-prefix nibble encoding and
+the <32-byte node-embedding rule. Goes beyond the reference by also keeping
+the built node structure around for proof generation (phant_tpu/mpt/proof.py)
+and for the TPU level-order hashing pipeline (phant_tpu/ops/mpt_pack.py):
+the reference computes roots only (reference: src/mpt/mpt.zig:38-45).
+
+Yellow-paper appendix D. Node kinds: leaf, extension, branch, empty.
+A node's reference inside its parent is its RLP structure itself when the
+encoding is shorter than 32 bytes, else keccak256 of the encoding.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple, Union
+
+from phant_tpu import rlp
+from phant_tpu.crypto.keccak import keccak256
+
+EMPTY_TRIE_ROOT = keccak256(rlp.encode(b""))
+
+
+def bytes_to_nibbles(key: bytes) -> Tuple[int, ...]:
+    out = []
+    for b in key:
+        out.append(b >> 4)
+        out.append(b & 0x0F)
+    return tuple(out)
+
+
+def encode_hex_prefix(nibbles: Sequence[int], is_leaf: bool) -> bytes:
+    """Hex-prefix encoding (yellow paper appendix C; reference:
+    src/mpt/mpt.zig:285-314)."""
+    flag = 0x20 if is_leaf else 0x00
+    if len(nibbles) % 2:  # odd
+        first = flag | 0x10 | nibbles[0]
+        rest = nibbles[1:]
+    else:
+        first = flag
+        rest = nibbles
+    out = bytearray([first])
+    for i in range(0, len(rest), 2):
+        out.append((rest[i] << 4) | rest[i + 1])
+    return bytes(out)
+
+
+def decode_hex_prefix(data: bytes) -> Tuple[Tuple[int, ...], bool]:
+    if not data:
+        raise ValueError("empty hex-prefix encoding")
+    flag = data[0]
+    is_leaf = bool(flag & 0x20)
+    nibbles: List[int] = []
+    if flag & 0x10:  # odd
+        nibbles.append(flag & 0x0F)
+    for b in data[1:]:
+        nibbles.append(b >> 4)
+        nibbles.append(b & 0x0F)
+    return tuple(nibbles), is_leaf
+
+
+# --- trie nodes -----------------------------------------------------------
+
+
+@dataclass
+class LeafNode:
+    path: Tuple[int, ...]
+    value: bytes
+
+
+@dataclass
+class ExtensionNode:
+    path: Tuple[int, ...]
+    child: "Node"
+
+
+@dataclass
+class BranchNode:
+    children: List[Optional["Node"]] = field(default_factory=lambda: [None] * 16)
+    value: Optional[bytes] = None
+
+
+Node = Union[LeafNode, ExtensionNode, BranchNode]
+
+
+def _common_prefix_len(a: Sequence[int], b: Sequence[int]) -> int:
+    n = min(len(a), len(b))
+    for i in range(n):
+        if a[i] != b[i]:
+            return i
+    return n
+
+
+def _insert(node: Optional[Node], path: Tuple[int, ...], value: bytes) -> Node:
+    """Insert (path, value); mirrors the reference's recursive insertNode
+    (reference: src/mpt/mpt.zig:47-119) but returns fresh subtree roots."""
+    if node is None:
+        return LeafNode(path, value)
+
+    if isinstance(node, LeafNode):
+        if node.path == path:
+            node.value = value
+            return node
+        common = _common_prefix_len(node.path, path)
+        branch = BranchNode()
+        old_rest, new_rest = node.path[common:], path[common:]
+        if not old_rest:
+            branch.value = node.value
+        else:
+            branch.children[old_rest[0]] = LeafNode(old_rest[1:], node.value)
+        if not new_rest:
+            branch.value = value
+        else:
+            branch.children[new_rest[0]] = LeafNode(new_rest[1:], value)
+        if common:
+            return ExtensionNode(node.path[:common], branch)
+        return branch
+
+    if isinstance(node, ExtensionNode):
+        common = _common_prefix_len(node.path, path)
+        if common == len(node.path):
+            node.child = _insert(node.child, path[common:], value)
+            return node
+        # split the extension
+        branch = BranchNode()
+        ext_rest = node.path[common:]
+        # the shortened old subtree hangs under ext_rest[0]
+        if len(ext_rest) == 1:
+            branch.children[ext_rest[0]] = node.child
+        else:
+            branch.children[ext_rest[0]] = ExtensionNode(ext_rest[1:], node.child)
+        new_rest = path[common:]
+        if not new_rest:
+            branch.value = value
+        else:
+            branch.children[new_rest[0]] = LeafNode(new_rest[1:], value)
+        if common:
+            return ExtensionNode(path[:common], branch)
+        return branch
+
+    # BranchNode
+    if not path:
+        node.value = value
+        return node
+    node.children[path[0]] = _insert(node.children[path[0]], path[1:], value)
+    return node
+
+
+class Trie:
+    """A build-once/query MPT over byte keys."""
+
+    def __init__(self):
+        self.root: Optional[Node] = None
+        # node-id -> (structure, encoding) memo; valid only between mutations
+        # (cleared on put; ids are stable while the trie is read-only).
+        self._enc_cache: Dict[int, Tuple[rlp.RLPItem, bytes]] = {}
+
+    def put(self, key: bytes, value: bytes) -> None:
+        if not value:
+            raise ValueError("MPT deletion (empty value) not supported in builder")
+        self._enc_cache.clear()
+        self.root = _insert(self.root, bytes_to_nibbles(key), value)
+
+    def get(self, key: bytes) -> Optional[bytes]:
+        node, path = self.root, bytes_to_nibbles(key)
+        while node is not None:
+            if isinstance(node, LeafNode):
+                return node.value if node.path == tuple(path) else None
+            if isinstance(node, ExtensionNode):
+                n = len(node.path)
+                if tuple(path[:n]) != node.path:
+                    return None
+                node, path = node.child, path[n:]
+                continue
+            if not path:
+                return node.value
+            node, path = node.children[path[0]], path[1:]
+        return None
+
+    # --- encoding ---------------------------------------------------------
+
+    def node_encoding(self, node: Node) -> Tuple[rlp.RLPItem, bytes]:
+        """(structure, rlp_encoding) of a node, memoized per build epoch —
+        proof generation and root hashing share subtree encodings instead of
+        re-walking them."""
+        cached = self._enc_cache.get(id(node))
+        if cached is not None:
+            return cached
+        if isinstance(node, LeafNode):
+            structure: rlp.RLPItem = [encode_hex_prefix(node.path, True), node.value]
+        elif isinstance(node, ExtensionNode):
+            structure = [encode_hex_prefix(node.path, False), self._ref(node.child)]
+        else:
+            slots: List[rlp.RLPItem] = []
+            for child in node.children:
+                slots.append(b"" if child is None else self._ref(child))
+            slots.append(node.value if node.value is not None else b"")
+            structure = slots
+        encoded = rlp.encode(structure)
+        result = (structure, encoded)
+        self._enc_cache[id(node)] = result
+        return result
+
+    def node_structure(self, node: Node) -> rlp.RLPItem:
+        """The node's RLP structure (list), before the embed-or-hash rule."""
+        return self.node_encoding(node)[0]
+
+    def _ref(self, node: Node) -> rlp.RLPItem:
+        """Reference to a child: embedded structure if rlp < 32B, else hash
+        (reference: src/mpt/mpt.zig:132-281 node encode paths)."""
+        structure, encoded = self.node_encoding(node)
+        if len(encoded) < 32:
+            return structure
+        return keccak256(encoded)
+
+    def root_hash(self) -> bytes:
+        if self.root is None:
+            return EMPTY_TRIE_ROOT
+        return keccak256(self.node_encoding(self.root)[1])
+
+
+# --- public API mirroring the reference ----------------------------------
+
+
+def trie_root(pairs: Iterable[Tuple[bytes, bytes]]) -> bytes:
+    """Root of the trie mapping key bytes -> value bytes (values already RLP).
+
+    Equivalent of the reference's `mptize` over KeyVals
+    (reference: src/mpt/mpt.zig:38-45)."""
+    trie = Trie()
+    for key, value in pairs:
+        trie.put(key, value)
+    return trie.root_hash()
+
+
+def ordered_trie_root(values: Sequence[bytes]) -> bytes:
+    """Root of the index-keyed trie used for tx/receipt/withdrawal roots:
+    key i = rlp(i) (reference: src/engine_api/execution_payload.zig:128-139,
+    src/blockchain/blockchain.zig:209-235)."""
+    return trie_root((rlp.encode(rlp.encode_uint(i)), v) for i, v in enumerate(values))
+
+
+def secure_trie_root(pairs: Iterable[Tuple[bytes, bytes]]) -> bytes:
+    """Root with keccak-hashed keys — the account/storage trie form. The
+    reference never builds this (state-root check is TODO-disabled,
+    reference: src/blockchain/blockchain.zig:83-85); the north star needs it."""
+    return trie_root((keccak256(k), v) for k, v in pairs)

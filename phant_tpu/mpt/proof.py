@@ -1,0 +1,139 @@
+"""MPT proofs and stateless witness verification.
+
+Beyond-reference functionality (the reference computes roots only,
+reference: src/mpt/mpt.zig:38-45): generate eth_getProof-style proofs from a
+built trie, and verify key/value pairs against a root from a bag of nodes —
+the CPU oracle for the batched TPU witness-verification pipeline
+(BASELINE.md config #3).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+from phant_tpu import rlp
+from phant_tpu.crypto.keccak import keccak256
+from phant_tpu.mpt.mpt import (
+    ExtensionNode,
+    LeafNode,
+    Node,
+    Trie,
+    bytes_to_nibbles,
+    decode_hex_prefix,
+    EMPTY_TRIE_ROOT,
+)
+
+
+class ProofError(ValueError):
+    """Raised when a proof is malformed or inconsistent with the root."""
+
+
+def generate_proof(trie: Trie, key: bytes) -> List[bytes]:
+    """RLP encodings of every hash-referenced node on the path to `key`
+    (embedded <32B nodes travel inside their parent, as in eth_getProof)."""
+    proof: List[bytes] = []
+    node = trie.root
+    if node is None:
+        return proof
+    path = bytes_to_nibbles(key)
+
+    def emit(n: Node) -> None:
+        encoded = trie.node_encoding(n)[1]
+        if len(encoded) >= 32 or n is trie.root:
+            proof.append(encoded)
+
+    while node is not None:
+        emit(node)
+        if isinstance(node, LeafNode):
+            return proof
+        if isinstance(node, ExtensionNode):
+            n = len(node.path)
+            if tuple(path[:n]) != node.path:
+                return proof
+            path = path[n:]
+            child = node.child
+        else:
+            if not path:
+                return proof
+            child = node.children[path[0]]
+            path = path[1:]
+        # embedded (<32B) children travel inside the parent encoding; `emit`
+        # filters them out while the walk continues through them.
+        node = child
+    return proof
+
+
+def _node_db(proof_nodes: Iterable[bytes]) -> Dict[bytes, bytes]:
+    return {keccak256(n): n for n in proof_nodes}
+
+
+def verify_proof(
+    root: bytes,
+    key: bytes,
+    proof_nodes: Sequence[bytes] = (),
+    node_db: Optional[Dict[bytes, bytes]] = None,
+) -> Optional[bytes]:
+    """Walk `key` from `root` through `proof_nodes`; returns the value, or
+    None for a valid absence proof. Raises ProofError on inconsistency.
+    Pass a prebuilt `node_db` (from :func:`_node_db`) to amortize hashing
+    across many keys."""
+    if root == EMPTY_TRIE_ROOT:
+        if node_db is None and list(proof_nodes):
+            raise ProofError("nonempty proof for empty root")
+        return None
+    db = node_db if node_db is not None else _node_db(proof_nodes)
+    path = list(bytes_to_nibbles(key))
+
+    def resolve(ref) -> rlp.RLPItem:
+        if isinstance(ref, list):  # embedded node structure
+            return ref
+        ref = bytes(ref)
+        if len(ref) != 32:
+            raise ProofError(f"bad node reference length {len(ref)}")
+        enc = db.get(ref)
+        if enc is None:
+            raise ProofError("missing proof node")
+        return rlp.decode(enc)
+
+    item: rlp.RLPItem = resolve(root)
+    while True:
+        if not isinstance(item, list):
+            raise ProofError("node is not a list")
+        if len(item) == 17:  # branch
+            if not path:
+                value = bytes(item[16])
+                return value or None
+            ref = item[path[0]]
+            if ref == b"" or ref == []:
+                return None  # absence
+            path = path[1:]
+            item = resolve(ref)
+            continue
+        if len(item) == 2:
+            nibbles, is_leaf = decode_hex_prefix(bytes(item[0]))
+            if is_leaf:
+                if tuple(path) == nibbles:
+                    return bytes(item[1])
+                return None  # absence (diverging leaf)
+            n = len(nibbles)
+            if tuple(path[:n]) != nibbles:
+                return None  # absence (diverging extension)
+            path = path[n:]
+            item = resolve(item[1])
+            continue
+        raise ProofError(f"node with {len(item)} items")
+
+
+def verify_witness(
+    root: bytes,
+    entries: Sequence[Tuple[bytes, Optional[bytes]]],
+    proof_nodes: Sequence[bytes],
+) -> bool:
+    """Multiproof/witness check: every (key, expected_value_or_None) must
+    verify against `root` using the shared node bag (hashed once)."""
+    db = _node_db(proof_nodes)
+    for key, expected in entries:
+        got = verify_proof(root, key, node_db=db)
+        if got != expected:
+            return False
+    return True

@@ -1,0 +1,46 @@
+"""World-state root computation (secure trie over hashed account keys).
+
+The reference skips state-root verification entirely (TODO-disabled,
+reference: src/blockchain/blockchain.zig:83-85); the north star requires it
+(BASELINE.json). Account leaf = rlp([nonce, balance, storage_root,
+code_hash]); account key = keccak(address); storage key = keccak(slot_be32),
+storage leaf = rlp(minimal_be(value)).
+"""
+
+from __future__ import annotations
+
+from typing import Mapping
+
+from phant_tpu import rlp
+from phant_tpu.crypto.keccak import keccak256
+from phant_tpu.mpt.mpt import Trie
+from phant_tpu.types.account import Account
+
+
+def storage_root(storage: Mapping[int, int]) -> bytes:
+    trie = Trie()
+    for slot, value in storage.items():
+        if value == 0:
+            continue  # zero slots are absent from the trie
+        key = keccak256(slot.to_bytes(32, "big"))
+        trie.put(key, rlp.encode(rlp.encode_uint(value)))
+    return trie.root_hash()
+
+
+def account_leaf(account: Account) -> bytes:
+    return rlp.encode([
+        rlp.encode_uint(account.nonce),
+        rlp.encode_uint(account.balance),
+        storage_root(account.storage),
+        account.code_hash(),
+    ])
+
+
+def state_root(accounts: Mapping[bytes, Account]) -> bytes:
+    """Root over address -> account, skipping EIP-161-empty accounts."""
+    trie = Trie()
+    for address, account in accounts.items():
+        if account.is_empty() and not account.storage:
+            continue
+        trie.put(keccak256(address), account_leaf(account))
+    return trie.root_hash()

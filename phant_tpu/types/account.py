@@ -5,11 +5,9 @@ from __future__ import annotations
 from dataclasses import dataclass, field
 from typing import Dict
 
-from phant_tpu import rlp
 from phant_tpu.crypto.keccak import keccak256, EMPTY_KECCAK
+from phant_tpu.mpt.mpt import EMPTY_TRIE_ROOT  # canonical definition
 
-# keccak(rlp(b"")) — root of the empty trie.
-EMPTY_TRIE_ROOT = keccak256(rlp.encode(b""))
 EMPTY_CODE_HASH = EMPTY_KECCAK
 
 

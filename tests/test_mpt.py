@@ -1,0 +1,171 @@
+"""MPT tests: golden roots from official fixtures, proofs, hex-prefix codec."""
+
+import os
+import random
+from pathlib import Path
+
+import pytest
+
+from phant_tpu import rlp
+from phant_tpu.crypto.keccak import keccak256
+from phant_tpu.mpt.mpt import (
+    EMPTY_TRIE_ROOT,
+    Trie,
+    bytes_to_nibbles,
+    decode_hex_prefix,
+    encode_hex_prefix,
+    ordered_trie_root,
+    trie_root,
+)
+from phant_tpu.mpt.proof import ProofError, generate_proof, verify_proof, verify_witness
+from phant_tpu.spec.fixtures import walk_fixtures
+from phant_tpu.state.root import state_root
+from phant_tpu.types.block import Block
+from phant_tpu.utils.hexutils import hex_to_bytes
+
+FIXTURES = Path(__file__).parent / "fixtures"
+
+
+def test_empty_trie_root_constant():
+    assert EMPTY_TRIE_ROOT.hex() == (
+        "56e81f171bcc55a6ff8345e692c0f86e5b48e01b996cadc001622fb5e363b421"
+    )
+    assert ordered_trie_root([]) == EMPTY_TRIE_ROOT
+
+
+def test_hex_prefix_roundtrip():
+    for nibbles, is_leaf in [
+        ((), False), ((), True), ((1,), False), ((1,), True),
+        ((0, 1, 2), True), ((15, 0, 15, 0), False), (tuple(range(16)), True),
+    ]:
+        enc = encode_hex_prefix(nibbles, is_leaf)
+        assert decode_hex_prefix(enc) == (nibbles, is_leaf)
+
+
+def test_hex_prefix_vectors():
+    # Yellow paper appendix C examples
+    assert encode_hex_prefix((1, 2, 3, 4, 5), False) == bytes.fromhex("112345")
+    assert encode_hex_prefix((0, 1, 2, 3, 4, 5), False) == bytes.fromhex("00012345")
+    assert encode_hex_prefix((15, 1, 12, 11, 8), True) == bytes.fromhex("3f1cb8")
+    assert encode_hex_prefix((0, 15, 1, 12, 11, 8), True) == bytes.fromhex("200f1cb8")
+
+
+def test_single_leaf_root():
+    key, value = b"\x01\x23", b"hello world, this value is >= 32 bytes!!"
+    expect = keccak256(rlp.encode([encode_hex_prefix(bytes_to_nibbles(key), True), value]))
+    assert trie_root([(key, value)]) == expect
+
+
+def test_insert_order_independence():
+    rng = random.Random(42)
+    pairs = [(os.urandom(rng.randint(1, 32)), os.urandom(rng.randint(1, 64)))
+             for _ in range(200)]
+    # dedupe keys (later wins); use dict semantics for both orders
+    d = dict(pairs)
+    items = list(d.items())
+    shuffled = items[:]
+    rng.shuffle(shuffled)
+    assert trie_root(items) == trie_root(shuffled)
+
+
+def test_get_returns_inserted():
+    trie = Trie()
+    d = {os.urandom(8): os.urandom(40) for _ in range(50)}
+    for k, v in d.items():
+        trie.put(k, v)
+    for k, v in d.items():
+        assert trie.get(k) == v
+    assert trie.get(b"\x00" * 8) is None or b"\x00" * 8 in d
+
+
+# --- golden roots from the official execution-spec-tests fixtures ---------
+
+
+@pytest.mark.parametrize("check", ["genesis_hash", "state_root", "block_roots"])
+def test_fixture_golden(check):
+    n = 0
+    for path, fx in walk_fixtures(FIXTURES):
+        n += 1
+        genesis = Block.decode(fx.genesis_rlp)
+        if check == "genesis_hash":
+            assert genesis.header.hash() == hex_to_bytes(fx.genesis_header_json["hash"])
+        elif check == "state_root":
+            assert state_root(fx.pre) == hex_to_bytes(
+                fx.genesis_header_json["stateRoot"]
+            ), f"{path.name}:{fx.name}"
+        else:
+            for fb in fx.blocks:
+                if fb.expect_exception:
+                    continue
+                block = Block.decode(fb.rlp)
+                assert ordered_trie_root(
+                    [tx.encode() for tx in block.transactions]
+                ) == block.header.transactions_root
+                if block.withdrawals is not None:
+                    assert ordered_trie_root(
+                        [w.encode() for w in block.withdrawals]
+                    ) == block.header.withdrawals_root
+    assert n >= 80  # 20 files, multiple forks/tests per file
+
+
+# --- proofs ---------------------------------------------------------------
+
+
+def _random_trie(n, seed=7):
+    rng = random.Random(seed)
+    trie = Trie()
+    d = {}
+    for _ in range(n):
+        k = bytes(rng.randrange(256) for _ in range(rng.randint(1, 16)))
+        v = bytes(rng.randrange(256) for _ in range(rng.randint(1, 80)))
+        d[k] = v
+    for k, v in d.items():
+        trie.put(k, v)
+    return trie, d
+
+
+def test_proof_roundtrip():
+    trie, d = _random_trie(150)
+    root = trie.root_hash()
+    for k, v in list(d.items())[:30]:
+        proof = generate_proof(trie, k)
+        assert verify_proof(root, k, proof) == v
+
+
+def test_absence_proof():
+    trie, d = _random_trie(50)
+    root = trie.root_hash()
+    missing = b"\xff" * 20
+    assert missing not in d
+    proof = generate_proof(trie, missing)
+    assert verify_proof(root, missing, proof) is None
+
+
+def test_tampered_proof_fails():
+    trie, d = _random_trie(80)
+    root = trie.root_hash()
+    k, v = next(iter(d.items()))
+    proof = generate_proof(trie, k)
+    # flip one byte of one node: either the walk breaks (ProofError) or the
+    # value comes out wrong — it must never silently verify.
+    bad = bytearray(proof[0])
+    bad[-1] ^= 0x01
+    tampered = [bytes(bad)] + list(proof[1:])
+    try:
+        got = verify_proof(root, k, tampered)
+        assert got != v
+    except ProofError:
+        pass
+
+
+def test_witness_multi_key():
+    trie, d = _random_trie(100)
+    root = trie.root_hash()
+    keys = list(d.keys())[:10] + [b"\xfe" * 10]
+    nodes = []
+    for k in keys:
+        nodes.extend(generate_proof(trie, k))
+    entries = [(k, d.get(k)) for k in keys]
+    assert verify_witness(root, entries, nodes)
+    wrong = [(keys[0], b"not the value")] + entries[1:]
+    assert not verify_witness(root, wrong, nodes)
