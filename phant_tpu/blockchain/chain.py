@@ -1,0 +1,351 @@
+"""Block-level validation and execution (the core hot loop).
+
+Equivalent surface to the reference Blockchain (reference:
+src/blockchain/blockchain.zig:44-377): header validation (gas-limit bounds,
+EIP-1559 base-fee recurrence, PoS fields, parent hash), the per-tx loop
+(sender recovery -> intrinsic gas -> warm-set prefill -> EVM execution ->
+refunds -> coinbase credit -> EIP-158 cleanup), withdrawals, and the
+post-execution root checks. Goes beyond the reference by actually verifying
+state root and logs bloom (TODO-disabled there,
+reference: blockchain.zig:83-88).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import List, Optional, Tuple
+
+from phant_tpu import rlp
+from phant_tpu.crypto.secp256k1 import SignatureError
+from phant_tpu.evm import gas as G
+from phant_tpu.evm.interpreter import Evm
+from phant_tpu.evm.message import Environment, Message
+from phant_tpu.evm.precompiles import precompile_addresses
+from phant_tpu.blockchain.fork import Fork, FrontierFork
+from phant_tpu.signer.signer import TxSigner
+from phant_tpu.state.statedb import StateDB
+from phant_tpu.types.block import Block, BlockHeader
+from phant_tpu.types.receipt import Receipt, logs_bloom
+from phant_tpu.types.transaction import (
+    FeeMarketTx,
+    Transaction,
+    access_list_of,
+    effective_gas_price,
+    max_fee_per_gas,
+)
+from phant_tpu.types.withdrawal import GWEI
+from phant_tpu.mpt.mpt import ordered_trie_root
+
+ELASTICITY_MULTIPLIER = 2  # reference: params.zig:36
+BASE_FEE_MAX_CHANGE_DENOMINATOR = 8  # reference: params.zig:37
+GAS_LIMIT_ADJUSTMENT_FACTOR = 1024  # reference: blockchain.zig:140-145
+GAS_LIMIT_MINIMUM = 5000
+
+
+class BlockError(Exception):
+    """Consensus-invalid block (maps to fixture expectException)."""
+
+
+@dataclass
+class BlockExecutionResult:
+    """(reference: blockchain.zig:147-153)"""
+
+    gas_used: int
+    receipts: List[Receipt]
+    logs_bloom: bytes
+
+
+class Blockchain:
+    """Holds chain config + parent header and runs blocks
+    (reference: blockchain.zig:44-96)."""
+
+    def __init__(
+        self,
+        chain_id: int,
+        state: StateDB,
+        parent_header: BlockHeader,
+        fork: Optional[Fork] = None,
+        verify_state_root: bool = True,
+    ):
+        self.chain_id = chain_id
+        self.state = state
+        self.parent_header = parent_header
+        self.fork = fork if fork is not None else FrontierFork()
+        self.signer = TxSigner(chain_id)
+        self.verify_state_root = verify_state_root
+
+    # ------------------------------------------------------------------
+
+    def run_block(self, block: Block) -> BlockExecutionResult:
+        """Validate + execute + verify roots (reference: blockchain.zig:61-96)."""
+        self.validate_block_header(block.header)
+        if block.uncles:
+            raise BlockError("post-merge blocks must have no uncles")
+
+        # record parent hash for BLOCKHASH (reference: blockchain.zig:71)
+        self.fork.update_parent_block_hash(
+            self.parent_header.block_number, self.parent_header.hash()
+        )
+
+        result = self.apply_body(block)
+
+        header = block.header
+        if result.gas_used != header.gas_used:
+            raise BlockError(
+                f"gas_used mismatch: computed {result.gas_used}, header {header.gas_used}"
+            )
+        tx_root = ordered_trie_root([tx.encode() for tx in block.transactions])
+        if tx_root != header.transactions_root:
+            raise BlockError("transactions root mismatch")
+        receipts_root = ordered_trie_root([r.encode() for r in result.receipts])
+        if receipts_root != header.receipts_root:
+            raise BlockError("receipts root mismatch")
+        if block.withdrawals is not None:
+            wd_root = ordered_trie_root([w.encode() for w in block.withdrawals])
+            if wd_root != header.withdrawals_root:
+                raise BlockError("withdrawals root mismatch")
+        if result.logs_bloom != header.logs_bloom:
+            raise BlockError("logs bloom mismatch")
+        if self.verify_state_root:
+            # beyond reference (TODO-disabled at blockchain.zig:83-85)
+            computed = self.state.state_root()
+            if computed != header.state_root:
+                raise BlockError(
+                    f"state root mismatch: {computed.hex()} != {header.state_root.hex()}"
+                )
+
+        self.parent_header = block.header
+        return result
+
+    # ------------------------------------------------------------------
+
+    def validate_block_header(self, header: BlockHeader) -> None:
+        """(reference: blockchain.zig:100-138)"""
+        parent = self.parent_header
+        if header.base_fee_per_gas is None:
+            raise BlockError("missing base fee (pre-London unsupported)")
+        expected_base_fee = calculate_base_fee(
+            parent.gas_limit, parent.gas_used,
+            parent.base_fee_per_gas if parent.base_fee_per_gas is not None else 0,
+        )
+        if header.base_fee_per_gas != expected_base_fee:
+            raise BlockError(
+                f"base fee mismatch: header {header.base_fee_per_gas}, expected {expected_base_fee}"
+            )
+        if header.gas_used > header.gas_limit:
+            raise BlockError("gas_used above gas_limit")
+        check_gas_limit(header.gas_limit, parent.gas_limit)
+        if header.timestamp <= parent.timestamp:
+            raise BlockError("timestamp not after parent")
+        if header.block_number != parent.block_number + 1:
+            raise BlockError("block number not parent+1")
+        if len(header.extra_data) > 32:
+            raise BlockError("extra data too long")
+        # PoS fields (reference: blockchain.zig:124-129)
+        if header.difficulty != 0:
+            raise BlockError("difficulty must be 0 post-merge")
+        if header.nonce != b"\x00" * 8:
+            raise BlockError("nonce must be zero post-merge")
+        from phant_tpu.types.block import EMPTY_UNCLE_HASH
+
+        if header.uncle_hash != EMPTY_UNCLE_HASH:
+            raise BlockError("uncle hash must be empty-list hash")
+        if header.parent_hash != parent.hash():
+            raise BlockError("parent hash mismatch")
+
+    # ------------------------------------------------------------------
+
+    def apply_body(self, block: Block) -> BlockExecutionResult:
+        """(reference: blockchain.zig:155-205)"""
+        header = block.header
+        gas_available = header.gas_limit
+        receipts: List[Receipt] = []
+        cumulative_gas = 0
+        all_logs = []
+
+        for tx in block.transactions:
+            sender = self.check_transaction(tx, header, gas_available)
+            gas_used, tx_logs, succeeded = self.process_transaction(tx, sender, header)
+            gas_available -= gas_used
+            cumulative_gas += gas_used
+            receipts.append(
+                Receipt(
+                    tx_type=tx.tx_type,
+                    succeeded=succeeded,
+                    cumulative_gas_used=cumulative_gas,
+                    logs=tuple(tx_logs),
+                )
+            )
+            all_logs.extend(tx_logs)
+
+        # withdrawals (reference: blockchain.zig:193-196)
+        if block.withdrawals:
+            for wd in block.withdrawals:
+                self.state.add_balance(wd.address, wd.amount * GWEI)
+                acct = self.state.get_account(wd.address)
+                if acct is not None and acct.is_empty():
+                    self.state.accounts.pop(wd.address, None)
+
+        return BlockExecutionResult(
+            gas_used=cumulative_gas,
+            receipts=receipts,
+            logs_bloom=logs_bloom(all_logs),
+        )
+
+    # ------------------------------------------------------------------
+
+    def check_transaction(self, tx: Transaction, header: BlockHeader, gas_available: int) -> bytes:
+        """(reference: blockchain.zig:237-260 + validateTransaction :345-353)"""
+        if tx.gas_limit > gas_available:
+            raise BlockError("tx gas limit exceeds available block gas")
+        base_fee = header.base_fee_per_gas or 0
+        if isinstance(tx, FeeMarketTx):
+            if tx.max_fee_per_gas < tx.max_priority_fee_per_gas:
+                raise BlockError("max fee below priority fee")
+            if tx.max_fee_per_gas < base_fee:
+                raise BlockError("max fee below base fee")
+        else:
+            if tx.gas_price < base_fee:
+                raise BlockError("gas price below base fee")
+        try:
+            sender = self.signer.get_sender(tx)
+        except SignatureError as e:
+            raise BlockError(f"invalid signature: {e}") from e
+
+        # intrinsic validity (reference: validateTransaction blockchain.zig:345-353)
+        is_create = tx.to is None
+        if is_create and len(tx.data) > G.MAX_INITCODE_SIZE:
+            raise BlockError("initcode exceeds EIP-3860 limit")
+        intrinsic = G.intrinsic_gas(
+            tx.data, is_create, access_list_of(tx), len(tx.data) if is_create else 0
+        )
+        if intrinsic > tx.gas_limit:
+            raise BlockError("intrinsic gas exceeds limit")
+
+        sender_acct = self.state.get_account(sender)
+        nonce = sender_acct.nonce if sender_acct else 0
+        if nonce != tx.nonce:
+            raise BlockError(f"nonce mismatch: tx {tx.nonce}, account {nonce}")
+        if sender_acct is not None and sender_acct.code:
+            raise BlockError("sender is not EOA (EIP-3607)")
+        max_cost = tx.gas_limit * max_fee_per_gas(tx) + tx.value
+        balance = sender_acct.balance if sender_acct else 0
+        if balance < max_cost:
+            raise BlockError("insufficient sender balance for gas + value")
+        return sender
+
+    # ------------------------------------------------------------------
+
+    def process_transaction(
+        self, tx: Transaction, sender: bytes, header: BlockHeader
+    ) -> Tuple[int, list, bool]:
+        """(reference: blockchain.zig:262-343)"""
+        state = self.state
+        state.start_tx()
+        base_fee = header.base_fee_per_gas or 0
+        gas_price = effective_gas_price(tx, base_fee)
+        priority_fee = gas_price - base_fee
+
+        env = Environment(
+            state=state,
+            origin=sender,
+            coinbase=header.fee_recipient,
+            block_number=header.block_number,
+            gas_limit=header.gas_limit,
+            gas_price=gas_price,
+            timestamp=header.timestamp,
+            prev_randao=header.prev_randao,
+            base_fee=base_fee,
+            chain_id=self.chain_id,
+            block_hash_fn=self.fork.get_block_hash,
+        )
+
+        # buy gas, bump nonce (reference: blockchain.zig:266-301)
+        state.sub_balance(sender, tx.gas_limit * gas_price)
+        state.increment_nonce(sender)
+
+        # EIP-2929 warm-set prefill incl. EIP-3651 warm coinbase
+        # (reference: blockchain.zig:293-301, params.zig:19-29)
+        state.access_address(sender)
+        state.access_address(header.fee_recipient)
+        for addr in precompile_addresses():
+            state.access_address(addr)
+        if tx.to is not None:
+            state.access_address(tx.to)
+        for addr, keys in access_list_of(tx):
+            state.access_address(addr)
+            for key in keys:
+                state.access_storage_key(addr, int.from_bytes(key, "big"))
+
+        intrinsic = G.intrinsic_gas(
+            tx.data, tx.to is None, access_list_of(tx),
+            len(tx.data) if tx.to is None else 0,
+        )
+        exec_gas = tx.gas_limit - intrinsic
+
+        evm = Evm(env)
+        msg = Message(
+            caller=sender,
+            target=tx.to,
+            value=tx.value,
+            data=tx.data,
+            gas=exec_gas,
+        )
+        result = evm.execute_message(msg)
+
+        # refunds (reference: blockchain.zig:312-331; EIP-3529 quotient 5)
+        gas_used = tx.gas_limit - result.gas_left
+        if result.success:
+            refund = min(state.refund, gas_used // G.REFUND_QUOTIENT)
+        else:
+            refund = 0
+        gas_used -= refund
+        state.add_balance(sender, (tx.gas_limit - gas_used) * gas_price)
+
+        # coinbase priority fee (reference: blockchain.zig:325-331)
+        state.touch(header.fee_recipient)
+        if priority_fee * gas_used:
+            state.add_balance(header.fee_recipient, priority_fee * gas_used)
+
+        # selfdestructs delete accounts wholesale
+        for addr in state.selfdestructs:
+            state.accounts.pop(addr, None)
+
+        # EIP-158 (reference: blockchain.zig:334-341 via statedb)
+        state.destroy_touched_empty()
+
+        logs = list(state.logs) if result.success else []
+        return gas_used, logs, result.success
+
+
+# ---------------------------------------------------------------------------
+
+
+def calculate_base_fee(parent_gas_limit: int, parent_gas_used: int, parent_base_fee: int) -> int:
+    """EIP-1559 recurrence (reference: blockchain.zig:107-123)."""
+    parent_gas_target = parent_gas_limit // ELASTICITY_MULTIPLIER
+    if parent_gas_used == parent_gas_target:
+        return parent_base_fee
+    if parent_gas_used > parent_gas_target:
+        gas_used_delta = parent_gas_used - parent_gas_target
+        delta = max(
+            parent_base_fee * gas_used_delta // parent_gas_target // BASE_FEE_MAX_CHANGE_DENOMINATOR,
+            1,
+        )
+        return parent_base_fee + delta
+    gas_used_delta = parent_gas_target - parent_gas_used
+    delta = (
+        parent_base_fee * gas_used_delta // parent_gas_target // BASE_FEE_MAX_CHANGE_DENOMINATOR
+    )
+    return parent_base_fee - delta
+
+
+def check_gas_limit(gas_limit: int, parent_gas_limit: int) -> None:
+    """(reference: blockchain.zig:140-145)"""
+    max_delta = parent_gas_limit // GAS_LIMIT_ADJUSTMENT_FACTOR
+    if gas_limit >= parent_gas_limit + max_delta:
+        raise BlockError("gas limit increased too much")
+    if gas_limit <= parent_gas_limit - max_delta:
+        raise BlockError("gas limit decreased too much")
+    if gas_limit < GAS_LIMIT_MINIMUM:
+        raise BlockError("gas limit below minimum")

@@ -1,0 +1,66 @@
+"""Fork-varying behavior: BLOCKHASH history.
+
+Equivalent surface to the reference's vtable (reference:
+src/blockchain/fork.zig:7-29): Frontier keeps an in-memory ring of the last
+256 ancestor hashes (reference: src/blockchain/forks/frontier.zig:12-58);
+Prague writes them into the EIP-2935 system contract's storage ring
+(reference: src/blockchain/forks/prague.zig:8-57).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+from phant_tpu.state.statedb import StateDB
+
+HISTORY_STORAGE_ADDRESS = bytes.fromhex("0000f90827f1c53a10cb7a02335b175320002935")
+HISTORY_SERVE_WINDOW = 8191
+
+
+class Fork:
+    """BLOCKHASH provider interface (reference: fork.zig:9-13)."""
+
+    def update_parent_block_hash(self, number: int, block_hash: bytes) -> None:
+        raise NotImplementedError
+
+    def get_block_hash(self, number: int) -> bytes:
+        raise NotImplementedError
+
+
+class FrontierFork(Fork):
+    """Ring buffer of the last 256 ancestor hashes
+    (reference: frontier.zig:29-58)."""
+
+    def __init__(self):
+        self._hashes: Dict[int, bytes] = {}
+
+    def update_parent_block_hash(self, number: int, block_hash: bytes) -> None:
+        self._hashes[number] = block_hash
+        self._hashes.pop(number - 256, None)
+
+    def get_block_hash(self, number: int) -> bytes:
+        return self._hashes.get(number, b"\x00" * 32)
+
+
+class PragueFork(Fork):
+    """EIP-2935: ancestor hashes in the history system contract
+    (reference: prague.zig:26-52; deployContract prague.zig:54-57)."""
+
+    def __init__(self, state: StateDB):
+        self.state = state
+        self.deploy_contract()
+
+    def deploy_contract(self) -> None:
+        if not self.state.get_code(HISTORY_STORAGE_ADDRESS):
+            acct = self.state.create_account(HISTORY_STORAGE_ADDRESS)
+            acct.nonce = 1
+            acct.code = b"\x00"  # placeholder body; spec contract is immaterial here
+
+    def update_parent_block_hash(self, number: int, block_hash: bytes) -> None:
+        slot = number % HISTORY_SERVE_WINDOW
+        acct = self.state.create_account(HISTORY_STORAGE_ADDRESS)
+        acct.storage[slot] = int.from_bytes(block_hash, "big")
+
+    def get_block_hash(self, number: int) -> bytes:
+        value = self.state.get_storage(HISTORY_STORAGE_ADDRESS, number % HISTORY_SERVE_WINDOW)
+        return value.to_bytes(32, "big")

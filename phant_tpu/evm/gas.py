@@ -1,0 +1,84 @@
+"""Gas schedule (Shanghai revision) and memory-expansion accounting.
+
+Constants mirror the reference's params (reference:
+src/blockchain/params.zig:5-39) plus the opcode-level costs evmone applies
+internally; collected here because this framework owns its interpreter.
+"""
+
+from __future__ import annotations
+
+# --- intrinsic tx costs (reference: src/blockchain/params.zig:5-17) -------
+TX_BASE_COST = 21_000
+TX_DATA_COST_ZERO = 4
+TX_DATA_COST_NONZERO = 16
+TX_CREATE_COST = 32_000
+TX_ACCESS_LIST_ADDRESS_COST = 2_400
+TX_ACCESS_LIST_STORAGE_KEY_COST = 1_900
+
+# --- EIP-2929 access costs -------------------------------------------------
+COLD_ACCOUNT_ACCESS = 2_600
+WARM_ACCOUNT_ACCESS = 100
+COLD_SLOAD = 2_100
+WARM_SLOAD = 100
+
+# --- storage (EIP-2200 + 3529) --------------------------------------------
+SSTORE_SET = 20_000
+SSTORE_RESET = 2_900  # 5000 - COLD_SLOAD
+SSTORE_SENTRY = 2_300
+SSTORE_CLEARS_REFUND = 4_800  # EIP-3529
+
+# --- create ----------------------------------------------------------------
+CREATE_GAS = 32_000
+CODE_DEPOSIT_PER_BYTE = 200
+MAX_CODE_SIZE = 0x6000  # EIP-170 (reference: params.zig:30)
+MAX_INITCODE_SIZE = 2 * MAX_CODE_SIZE  # EIP-3860
+INITCODE_WORD_COST = 2  # EIP-3860
+
+# --- calls -----------------------------------------------------------------
+CALL_VALUE_GAS = 9_000
+CALL_STIPEND = 2_300
+NEW_ACCOUNT_GAS = 25_000
+MAX_CALL_DEPTH = 1024  # reference: params.zig:33
+
+# --- misc opcode costs ------------------------------------------------------
+KECCAK256_GAS = 30
+KECCAK256_WORD_GAS = 6
+COPY_WORD_GAS = 3
+LOG_GAS = 375
+LOG_TOPIC_GAS = 375
+LOG_DATA_GAS = 8
+EXP_GAS = 10
+EXP_BYTE_GAS = 50
+SELFDESTRUCT_GAS = 5_000
+MEMORY_GAS = 3
+QUAD_COEFF_DIV = 512
+REFUND_QUOTIENT = 5  # EIP-3529 (gas_used // 5 cap, reference: blockchain.zig:315)
+
+GWEI = 10**9
+
+U256_MAX = (1 << 256) - 1
+
+
+def memory_cost(size_bytes: int) -> int:
+    """Total cost of having `size_bytes` of memory (yellow paper C_mem)."""
+    words = (size_bytes + 31) // 32
+    return MEMORY_GAS * words + (words * words) // QUAD_COEFF_DIV
+
+
+def copy_cost(length: int) -> int:
+    return COPY_WORD_GAS * ((length + 31) // 32)
+
+
+def intrinsic_gas(data: bytes, is_create: bool, access_list, init_code_len: int = 0) -> int:
+    """Intrinsic cost before execution (reference:
+    src/blockchain/blockchain.zig:355-377, incl. EIP-3860 word cost)."""
+    gas = TX_BASE_COST
+    for byte in data:
+        gas += TX_DATA_COST_ZERO if byte == 0 else TX_DATA_COST_NONZERO
+    if is_create:
+        gas += TX_CREATE_COST
+        gas += INITCODE_WORD_COST * ((init_code_len + 31) // 32)
+    for _, keys in access_list:
+        gas += TX_ACCESS_LIST_ADDRESS_COST
+        gas += TX_ACCESS_LIST_STORAGE_KEY_COST * len(keys)
+    return gas

@@ -1,0 +1,79 @@
+"""EVM call/create messages, environment, and execution results.
+
+Equivalent surface to the reference's Environment/Message
+(reference: src/blockchain/types.zig:13-33) and MessageCallOutput
+(reference: src/blockchain/vm.zig:560-566).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Callable, Optional, TYPE_CHECKING
+
+if TYPE_CHECKING:
+    from phant_tpu.state.statedb import StateDB
+
+
+@dataclass
+class Environment:
+    """Per-tx EVM environment (reference: src/blockchain/types.zig:13-25)."""
+
+    state: "StateDB"
+    origin: bytes = b"\x00" * 20
+    coinbase: bytes = b"\x00" * 20
+    block_number: int = 0
+    gas_limit: int = 30_000_000
+    gas_price: int = 0
+    timestamp: int = 0
+    prev_randao: bytes = b"\x00" * 32
+    difficulty: int = 0
+    base_fee: int = 0
+    chain_id: int = 1
+    block_hash_fn: Optional[Callable[[int], bytes]] = None  # fork BLOCKHASH
+
+    def get_block_hash(self, number: int) -> bytes:
+        if self.block_hash_fn is None:
+            return b"\x00" * 32
+        return self.block_hash_fn(number)
+
+
+@dataclass
+class Message:
+    """One call or create (reference: src/blockchain/types.zig:27-33)."""
+
+    caller: bytes
+    target: Optional[bytes]  # None => contract creation
+    value: int
+    data: bytes
+    gas: int
+    is_static: bool = False
+    depth: int = 0
+    # for CALLCODE/DELEGATECALL the executing address differs from code source
+    code_address: Optional[bytes] = None
+    salt: Optional[bytes] = None  # CREATE2
+    # DELEGATECALL carries the parent's value for CALLVALUE but must not move
+    # funds again (reference: vm.zig:444-466 only transfers for CALL kinds)
+    transfers_value: bool = True
+
+
+@dataclass
+class ExecResult:
+    """Frame outcome (reference: src/blockchain/vm.zig:560-566)."""
+
+    success: bool
+    gas_left: int
+    output: bytes = b""
+    error: Optional[str] = None
+    create_address: Optional[bytes] = None
+
+    @property
+    def is_revert(self) -> bool:
+        return not self.success and self.error == "revert"
+
+
+class EVMError(Exception):
+    """Exceptional halt: consumes all frame gas."""
+
+    def __init__(self, reason: str):
+        super().__init__(reason)
+        self.reason = reason

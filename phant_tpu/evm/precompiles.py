@@ -1,0 +1,249 @@
+"""Precompiled contracts at addresses 0x01..0x09 (Shanghai set).
+
+The reference only lists the nine addresses for EIP-2929 warm-set prefill
+(reference: src/blockchain/params.zig:19-29) and relies on evmone for
+behavior; here each is implemented natively in Python (bn254 pairing in
+phant_tpu/crypto/bn254.py).
+"""
+
+from __future__ import annotations
+
+import hashlib
+from typing import Callable, Dict, List
+
+from phant_tpu.crypto import secp256k1
+from phant_tpu.evm.message import ExecResult
+
+
+def _addr(n: int) -> bytes:
+    return n.to_bytes(20, "big")
+
+
+def precompile_addresses() -> List[bytes]:
+    return [_addr(i) for i in range(1, 10)]
+
+
+def _words(n: int) -> int:
+    return (n + 31) // 32
+
+
+# --- 0x01 ecrecover --------------------------------------------------------
+
+
+def _ecrecover(data: bytes, gas: int) -> ExecResult:
+    GAS = 3000
+    if gas < GAS:
+        return ExecResult(False, 0, error="out of gas")
+    gas -= GAS
+    data = data[:128].ljust(128, b"\x00")
+    h, v_b, r_b, s_b = data[:32], data[32:64], data[64:96], data[96:128]
+    v = int.from_bytes(v_b, "big")
+    r = int.from_bytes(r_b, "big")
+    s = int.from_bytes(s_b, "big")
+    if v not in (27, 28) or not (1 <= r < secp256k1.N) or not (1 <= s < secp256k1.N):
+        return ExecResult(True, gas, b"")
+    try:
+        pub = secp256k1.recover_pubkey(h, r, s, v - 27)
+    except secp256k1.SignatureError:
+        return ExecResult(True, gas, b"")
+    from phant_tpu.crypto.keccak import keccak256
+
+    address = keccak256(pub[1:])[12:]
+    return ExecResult(True, gas, address.rjust(32, b"\x00"))
+
+
+# --- 0x02 sha256 / 0x03 ripemd160 / 0x04 identity --------------------------
+
+
+def _sha256(data: bytes, gas: int) -> ExecResult:
+    cost = 60 + 12 * _words(len(data))
+    if gas < cost:
+        return ExecResult(False, 0, error="out of gas")
+    return ExecResult(True, gas - cost, hashlib.sha256(data).digest())
+
+
+def _ripemd160(data: bytes, gas: int) -> ExecResult:
+    cost = 600 + 120 * _words(len(data))
+    if gas < cost:
+        return ExecResult(False, 0, error="out of gas")
+    try:
+        digest = hashlib.new("ripemd160", data).digest()
+    except ValueError:  # OpenSSL without ripemd160
+        from phant_tpu.crypto.ripemd160 import ripemd160 as _rmd
+
+        digest = _rmd(data)
+    return ExecResult(True, gas - cost, digest.rjust(32, b"\x00"))
+
+
+def _identity(data: bytes, gas: int) -> ExecResult:
+    cost = 15 + 3 * _words(len(data))
+    if gas < cost:
+        return ExecResult(False, 0, error="out of gas")
+    return ExecResult(True, gas - cost, data)
+
+
+# --- 0x05 modexp (EIP-2565) ------------------------------------------------
+
+
+def _modexp(data: bytes, gas: int) -> ExecResult:
+    def read(off: int, size: int) -> bytes:
+        chunk = data[off : off + size]
+        return chunk.ljust(size, b"\x00")
+
+    b_len = int.from_bytes(read(0, 32), "big")
+    e_len = int.from_bytes(read(32, 32), "big")
+    m_len = int.from_bytes(read(64, 32), "big")
+
+    # EIP-2565 gas — computed from lengths + exponent head ONLY, before any
+    # large operand is materialized, so gas (not an artificial cap) bounds work
+    max_len = max(b_len, m_len)
+    mult_complexity = ((max_len + 7) // 8) ** 2
+    e_head = int.from_bytes(read(96 + b_len, min(e_len, 32)), "big")
+    if e_len <= 32:
+        iter_count = max(e_head.bit_length() - 1, 0)
+    else:
+        iter_count = 8 * (e_len - 32) + max(e_head.bit_length() - 1, 0)
+    iter_count = max(iter_count, 1)
+    cost = max(200, mult_complexity * iter_count // 3)
+    if gas < cost:
+        return ExecResult(False, 0, error="out of gas")
+
+    b = int.from_bytes(read(96, b_len), "big")
+    e = int.from_bytes(read(96 + b_len, e_len), "big")
+    m = int.from_bytes(read(96 + b_len + e_len, m_len), "big")
+    if m == 0:
+        out = b"\x00" * m_len
+    else:
+        out = pow(b, e, m).to_bytes(m_len, "big")
+    return ExecResult(True, gas - cost, out)
+
+
+# --- 0x06/0x07/0x08 alt_bn128 ---------------------------------------------
+
+
+def _bn_add(data: bytes, gas: int) -> ExecResult:
+    cost = 150
+    if gas < cost:
+        return ExecResult(False, 0, error="out of gas")
+    from phant_tpu.crypto import bn254
+
+    try:
+        out = bn254.ec_add_bytes(data)
+    except bn254.BN254Error:
+        return ExecResult(False, 0, error="bn254 invalid point")
+    return ExecResult(True, gas - cost, out)
+
+
+def _bn_mul(data: bytes, gas: int) -> ExecResult:
+    cost = 6000
+    if gas < cost:
+        return ExecResult(False, 0, error="out of gas")
+    from phant_tpu.crypto import bn254
+
+    try:
+        out = bn254.ec_mul_bytes(data)
+    except bn254.BN254Error:
+        return ExecResult(False, 0, error="bn254 invalid point")
+    return ExecResult(True, gas - cost, out)
+
+
+def _bn_pairing(data: bytes, gas: int) -> ExecResult:
+    if len(data) % 192:
+        return ExecResult(False, 0, error="bn254 pairing input length")
+    k = len(data) // 192
+    cost = 45_000 + 34_000 * k
+    if gas < cost:
+        return ExecResult(False, 0, error="out of gas")
+    from phant_tpu.crypto import bn254
+
+    try:
+        ok = bn254.pairing_check_bytes(data)
+    except bn254.BN254Error:
+        return ExecResult(False, 0, error="bn254 invalid point")
+    return ExecResult(True, gas - cost, (1 if ok else 0).to_bytes(32, "big"))
+
+
+# --- 0x09 blake2f (EIP-152) ------------------------------------------------
+
+_BLAKE2B_IV = [
+    0x6A09E667F3BCC908, 0xBB67AE8584CAA73B, 0x3C6EF372FE94F82B, 0xA54FF53A5F1D36F1,
+    0x510E527FADE682D1, 0x9B05688C2B3E6C1F, 0x1F83D9ABFB41BD6B, 0x5BE0CD19137E2179,
+]
+
+_BLAKE2B_SIGMA = [
+    [0, 1, 2, 3, 4, 5, 6, 7, 8, 9, 10, 11, 12, 13, 14, 15],
+    [14, 10, 4, 8, 9, 15, 13, 6, 1, 12, 0, 2, 11, 7, 5, 3],
+    [11, 8, 12, 0, 5, 2, 15, 13, 10, 14, 3, 6, 7, 1, 9, 4],
+    [7, 9, 3, 1, 13, 12, 11, 14, 2, 6, 5, 10, 4, 0, 15, 8],
+    [9, 0, 5, 7, 2, 4, 10, 15, 14, 1, 11, 12, 6, 8, 3, 13],
+    [2, 12, 6, 10, 0, 11, 8, 3, 4, 13, 7, 5, 15, 14, 1, 9],
+    [12, 5, 1, 15, 14, 13, 4, 10, 0, 7, 6, 3, 9, 2, 8, 11],
+    [13, 11, 7, 14, 12, 1, 3, 9, 5, 0, 15, 4, 8, 6, 2, 10],
+    [6, 15, 14, 9, 11, 3, 0, 8, 12, 2, 13, 7, 1, 4, 10, 5],
+    [10, 2, 8, 4, 7, 6, 1, 5, 15, 11, 9, 14, 3, 12, 13, 0],
+]
+
+_M64 = (1 << 64) - 1
+
+
+def _rotr64(x: int, n: int) -> int:
+    return ((x >> n) | (x << (64 - n))) & _M64
+
+
+def _blake2_g(v, a, b, c, d, x, y):
+    v[a] = (v[a] + v[b] + x) & _M64
+    v[d] = _rotr64(v[d] ^ v[a], 32)
+    v[c] = (v[c] + v[d]) & _M64
+    v[b] = _rotr64(v[b] ^ v[c], 24)
+    v[a] = (v[a] + v[b] + y) & _M64
+    v[d] = _rotr64(v[d] ^ v[a], 16)
+    v[c] = (v[c] + v[d]) & _M64
+    v[b] = _rotr64(v[b] ^ v[c], 63)
+
+
+def _blake2f(data: bytes, gas: int) -> ExecResult:
+    if len(data) != 213:
+        return ExecResult(False, 0, error="blake2f input length")
+    rounds = int.from_bytes(data[0:4], "big")
+    if gas < rounds:
+        return ExecResult(False, 0, error="out of gas")
+    final = data[212]
+    if final not in (0, 1):
+        return ExecResult(False, 0, error="blake2f final flag")
+    h = [int.from_bytes(data[4 + 8 * i : 12 + 8 * i], "little") for i in range(8)]
+    m = [int.from_bytes(data[68 + 8 * i : 76 + 8 * i], "little") for i in range(16)]
+    t0 = int.from_bytes(data[196:204], "little")
+    t1 = int.from_bytes(data[204:212], "little")
+
+    v = h[:] + _BLAKE2B_IV[:]
+    v[12] ^= t0
+    v[13] ^= t1
+    if final:
+        v[14] ^= _M64
+    for r in range(rounds):
+        s = _BLAKE2B_SIGMA[r % 10]
+        _blake2_g(v, 0, 4, 8, 12, m[s[0]], m[s[1]])
+        _blake2_g(v, 1, 5, 9, 13, m[s[2]], m[s[3]])
+        _blake2_g(v, 2, 6, 10, 14, m[s[4]], m[s[5]])
+        _blake2_g(v, 3, 7, 11, 15, m[s[6]], m[s[7]])
+        _blake2_g(v, 0, 5, 10, 15, m[s[8]], m[s[9]])
+        _blake2_g(v, 1, 6, 11, 12, m[s[10]], m[s[11]])
+        _blake2_g(v, 2, 7, 8, 13, m[s[12]], m[s[13]])
+        _blake2_g(v, 3, 4, 9, 14, m[s[14]], m[s[15]])
+    out = b"".join(
+        ((h[i] ^ v[i] ^ v[i + 8]) & _M64).to_bytes(8, "little") for i in range(8)
+    )
+    return ExecResult(True, gas - rounds, out)
+
+
+PRECOMPILES: Dict[bytes, Callable[[bytes, int], ExecResult]] = {
+    _addr(1): _ecrecover,
+    _addr(2): _sha256,
+    _addr(3): _ripemd160,
+    _addr(4): _identity,
+    _addr(5): _modexp,
+    _addr(6): _bn_add,
+    _addr(7): _bn_mul,
+    _addr(8): _bn_pairing,
+    _addr(9): _blake2f,
+}

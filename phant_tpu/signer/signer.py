@@ -1,0 +1,122 @@
+"""Transaction signing-hash construction and sender recovery.
+
+Equivalent surface to the reference TxSigner (reference:
+src/signer/signer.zig:27-188): per-type signing payloads (pre/post EIP-155
+legacy, EIP-2930/1559 typed with their 0x01/0x02 prefix), v/y_parity
+normalization, r/s validation, and sender = keccak(pubkey[1:])[12:].
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+from phant_tpu import rlp
+from phant_tpu.crypto import secp256k1
+from phant_tpu.crypto.keccak import keccak256
+from phant_tpu.crypto.secp256k1 import SignatureError
+from phant_tpu.types.transaction import (
+    AccessListTx,
+    FeeMarketTx,
+    LegacyTx,
+    Transaction,
+    _encode_access_list,
+)
+
+
+def address_from_pubkey(pubkey65: bytes) -> bytes:
+    """sender = keccak(uncompressed pubkey minus the 0x04 tag)[12:]
+    (reference: src/signer/signer.zig:78)."""
+    if len(pubkey65) != 65 or pubkey65[0] != 0x04:
+        raise SignatureError("expected 65-byte uncompressed pubkey")
+    return keccak256(pubkey65[1:])[12:]
+
+
+def signing_hash(tx: Transaction, chain_id: int) -> bytes:
+    """Hash the signature covers (reference: src/signer/signer.zig:81-188)."""
+    if isinstance(tx, LegacyTx):
+        base = [
+            rlp.encode_uint(tx.nonce),
+            rlp.encode_uint(tx.gas_price),
+            rlp.encode_uint(tx.gas_limit),
+            tx.to if tx.to is not None else b"",
+            rlp.encode_uint(tx.value),
+            tx.data,
+        ]
+        if tx.v in (27, 28):  # pre-EIP-155: six fields
+            return keccak256(rlp.encode(base))
+        # EIP-155: nine fields with chain_id, 0, 0
+        base += [rlp.encode_uint(chain_id), b"", b""]
+        return keccak256(rlp.encode(base))
+    if isinstance(tx, AccessListTx):
+        payload = [
+            rlp.encode_uint(tx.chain_id_val),
+            rlp.encode_uint(tx.nonce),
+            rlp.encode_uint(tx.gas_price),
+            rlp.encode_uint(tx.gas_limit),
+            tx.to if tx.to is not None else b"",
+            rlp.encode_uint(tx.value),
+            tx.data,
+            _encode_access_list(tx.access_list),
+        ]
+        return keccak256(b"\x01" + rlp.encode(payload))
+    if isinstance(tx, FeeMarketTx):
+        payload = [
+            rlp.encode_uint(tx.chain_id_val),
+            rlp.encode_uint(tx.nonce),
+            rlp.encode_uint(tx.max_priority_fee_per_gas),
+            rlp.encode_uint(tx.max_fee_per_gas),
+            rlp.encode_uint(tx.gas_limit),
+            tx.to if tx.to is not None else b"",
+            rlp.encode_uint(tx.value),
+            tx.data,
+            _encode_access_list(tx.access_list),
+        ]
+        return keccak256(b"\x02" + rlp.encode(payload))
+    raise TypeError(f"unknown tx type {type(tx).__name__}")
+
+
+def recovery_fields(tx: Transaction, chain_id: int) -> Tuple[int, int, int]:
+    """(r, s, recovery_id), normalizing legacy v
+    (reference: src/signer/signer.zig:45-75)."""
+    if isinstance(tx, LegacyTx):
+        v = tx.v
+        if v in (27, 28):
+            rec_id = v - 27
+        else:
+            derived = 35 + 2 * chain_id
+            if v not in (derived, derived + 1):
+                raise SignatureError(f"v {v} inconsistent with chain id {chain_id}")
+            rec_id = v - derived
+    else:
+        if tx.y_parity not in (0, 1):
+            raise SignatureError(f"bad y_parity {tx.y_parity}")
+        if tx.chain_id_val != chain_id:
+            raise SignatureError("tx chain id mismatch")
+        rec_id = tx.y_parity
+    return tx.r, tx.s, rec_id
+
+
+class TxSigner:
+    """Chain-id-aware sender recovery + test signing
+    (reference: src/signer/signer.zig:20-79)."""
+
+    def __init__(self, chain_id: int):
+        self.chain_id = chain_id
+
+    def get_sender(self, tx: Transaction) -> bytes:
+        r, s, rec_id = recovery_fields(tx, self.chain_id)
+        secp256k1.validate_signature_fields(r, s)
+        msg = signing_hash(tx, self.chain_id)
+        pub = secp256k1.recover_pubkey(msg, r, s, rec_id)
+        return address_from_pubkey(pub)
+
+    def sign(self, tx: Transaction, private_key: int) -> Transaction:
+        """Returns a copy of `tx` carrying the signature."""
+        from dataclasses import replace
+
+        msg = signing_hash(tx, self.chain_id)
+        r, s, y_parity = secp256k1.sign(msg, private_key)
+        if isinstance(tx, LegacyTx):
+            v = 35 + 2 * self.chain_id + y_parity if tx.v not in (27, 28) else 27 + y_parity
+            return replace(tx, v=v, r=r, s=s)
+        return replace(tx, y_parity=y_parity, r=r, s=s)

@@ -1,0 +1,134 @@
+"""Execution-spec-tests fixture runner.
+
+Equivalent surface to the reference's FixtureTest.run
+(reference: src/tests/spec_tests.zig:58-132): build pre-state, decode
+genesis, run each block through the Blockchain honoring expectException,
+then diff the full post-state (nonce / balance / every storage slot).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import List, Optional
+
+from phant_tpu.blockchain.chain import Blockchain, BlockError
+from phant_tpu.spec.fixtures import Fixture, walk_fixtures
+from phant_tpu.state.statedb import StateDB
+from phant_tpu.types.block import Block
+from phant_tpu import rlp
+
+
+class FixtureFailure(AssertionError):
+    pass
+
+
+@dataclass
+class RunStats:
+    passed: int = 0
+    failed: int = 0
+    failures: List[str] = field(default_factory=list)
+
+
+def run_fixture(fixture: Fixture) -> None:
+    """Raises FixtureFailure on any divergence from the fixture oracle."""
+    state = StateDB(dict(fixture.pre))
+    genesis = Block.decode(fixture.genesis_rlp)
+
+    chain = Blockchain(
+        chain_id=1,  # fixtures run on chain id 1 (SpecTest network)
+        state=state,
+        parent_header=genesis.header,
+    )
+
+    last_valid_hash = genesis.header.hash()
+    for i, fb in enumerate(fixture.blocks):
+        backup = state.copy()
+        parent_backup = chain.parent_header
+        try:
+            block = Block.decode(fb.rlp)
+            chain.run_block(block)
+            ran_ok = True
+        except (BlockError, rlp.DecodeError, ValueError, KeyError, IndexError) as e:
+            ran_ok = False
+            error = e
+            # an invalid block must leave no trace (partial execution rolls back)
+            state.accounts = backup.accounts
+            chain.parent_header = parent_backup
+        if fb.expect_exception:
+            if ran_ok:
+                raise FixtureFailure(
+                    f"{fixture.name}: block {i} expected exception "
+                    f"{fb.expect_exception!r} but ran fine"
+                )
+            continue  # invalid block correctly rejected; state untouched? see note
+        if not ran_ok:
+            raise FixtureFailure(f"{fixture.name}: block {i} failed: {error}")
+        last_valid_hash = chain.parent_header.hash()
+
+    if last_valid_hash != fixture.last_block_hash:
+        raise FixtureFailure(
+            f"{fixture.name}: lastblockhash mismatch "
+            f"{last_valid_hash.hex()} != {fixture.last_block_hash.hex()}"
+        )
+
+    diff_post_state(fixture, state)
+
+
+def diff_post_state(fixture: Fixture, state: StateDB) -> None:
+    """(reference: spec_tests.zig:103-129)"""
+    for addr, want in fixture.post_state.items():
+        got = state.get_account(addr)
+        if got is None:
+            if want.is_empty() and not want.storage:
+                continue
+            raise FixtureFailure(f"{fixture.name}: missing account 0x{addr.hex()}")
+        if got.nonce != want.nonce:
+            raise FixtureFailure(
+                f"{fixture.name}: 0x{addr.hex()} nonce {got.nonce} != {want.nonce}"
+            )
+        if got.balance != want.balance:
+            raise FixtureFailure(
+                f"{fixture.name}: 0x{addr.hex()} balance {got.balance} != {want.balance}"
+            )
+        if got.code != want.code:
+            raise FixtureFailure(f"{fixture.name}: 0x{addr.hex()} code mismatch")
+        got_storage = {k: v for k, v in got.storage.items() if v}
+        want_storage = {k: v for k, v in want.storage.items() if v}
+        if got_storage != want_storage:
+            raise FixtureFailure(
+                f"{fixture.name}: 0x{addr.hex()} storage {got_storage} != {want_storage}"
+            )
+
+
+def run_directory(root: Path) -> RunStats:
+    stats = RunStats()
+    for path, fixture in walk_fixtures(root):
+        try:
+            run_fixture(fixture)
+            stats.passed += 1
+        except Exception as e:  # noqa: BLE001 — collect everything for the report
+            stats.failed += 1
+            stats.failures.append(f"{path.name} :: {fixture.name} :: {e}")
+    return stats
+
+
+def main() -> int:
+    import argparse
+
+    parser = argparse.ArgumentParser(description="Run execution-spec-tests fixtures")
+    parser.add_argument("root", type=Path, help="fixture directory")
+    args = parser.parse_args()
+    if not args.root.is_dir():
+        parser.error(f"fixture directory not found: {args.root}")
+    stats = run_directory(args.root)
+    if stats.passed + stats.failed == 0:
+        parser.error(f"no fixture JSONs under {args.root}")
+    for line in stats.failures:
+        print("FAIL", line)
+    print(f"{stats.passed} passed, {stats.failed} failed")
+    return 1 if stats.failed else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

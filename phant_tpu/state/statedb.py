@@ -1,0 +1,279 @@
+"""In-memory world state with journaled snapshots.
+
+Equivalent surface to the reference StateDB (reference:
+src/state/statedb.zig:16-194) — accounts/storage CRUD, per-tx original
+values for SSTORE gas, EIP-2929 warm sets, touched-address tracking — but
+snapshots are O(1) journal marks with undo-log revert instead of the
+reference's full deep clone (its own TODO admits the inefficiency,
+reference: src/state/statedb.zig:172-173).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Set, Tuple
+
+from phant_tpu.types.account import Account
+from phant_tpu.types.receipt import Log
+from phant_tpu.state.root import state_root as _state_root
+
+Address = bytes  # 20 bytes
+
+
+class StateDB:
+    def __init__(self, accounts: Optional[Dict[Address, Account]] = None):
+        self.accounts: Dict[Address, Account] = accounts or {}
+        # undo log: list of (tag, payload) entries, newest last
+        self._journal: List[Tuple] = []
+        # --- per-transaction scope ---
+        self._tx_original: Dict[Tuple[Address, int], int] = {}
+        self.accessed_addresses: Set[Address] = set()
+        self.accessed_storage_keys: Set[Tuple[Address, int]] = set()
+        self.touched: Set[Address] = set()
+        self.selfdestructs: Set[Address] = set()
+        self.created: Set[Address] = set()
+        self.logs: List[Log] = []
+        self.refund: int = 0
+
+    # ------------------------------------------------------------------
+    # tx lifecycle
+    # ------------------------------------------------------------------
+
+    def start_tx(self) -> None:
+        """Reset per-tx scopes (reference: src/state/statedb.zig:62-69 clones
+        the whole db as `original_db`; we record originals lazily instead)."""
+        self._journal.clear()
+        self._tx_original.clear()
+        self.accessed_addresses = set()
+        self.accessed_storage_keys = set()
+        self.touched = set()
+        self.selfdestructs = set()
+        self.created = set()
+        self.logs = []
+        self.refund = 0
+
+    # ------------------------------------------------------------------
+    # snapshots (journal marks)
+    # ------------------------------------------------------------------
+
+    def snapshot(self) -> int:
+        """O(1) — returns a journal mark (reference deep-clones both maps,
+        src/state/statedb.zig:171-182)."""
+        return len(self._journal)
+
+    def revert_to(self, mark: int) -> None:
+        while len(self._journal) > mark:
+            tag, *payload = self._journal.pop()
+            if tag == "balance":
+                addr, old = payload
+                self.accounts[addr].balance = old
+            elif tag == "nonce":
+                addr, old = payload
+                self.accounts[addr].nonce = old
+            elif tag == "storage":
+                addr, slot, old = payload
+                acct = self.accounts[addr]
+                if old == 0:
+                    acct.storage.pop(slot, None)
+                else:
+                    acct.storage[slot] = old
+            elif tag == "code":
+                addr, old = payload
+                self.accounts[addr].code = old
+            elif tag == "create_account":
+                (addr,) = payload
+                self.accounts.pop(addr, None)
+            elif tag == "delete_account":
+                addr, acct = payload
+                self.accounts[addr] = acct
+            elif tag == "warm_addr":
+                (addr,) = payload
+                self.accessed_addresses.discard(addr)
+            elif tag == "warm_slot":
+                (key,) = payload
+                self.accessed_storage_keys.discard(key)
+            elif tag == "touch":
+                (addr,) = payload
+                self.touched.discard(addr)
+            elif tag == "selfdestruct":
+                (addr,) = payload
+                self.selfdestructs.discard(addr)
+            elif tag == "created":
+                (addr,) = payload
+                self.created.discard(addr)
+            elif tag == "log":
+                self.logs.pop()
+            elif tag == "refund":
+                (old,) = payload
+                self.refund = old
+            else:  # pragma: no cover
+                raise AssertionError(f"unknown journal tag {tag}")
+
+    # ------------------------------------------------------------------
+    # accounts
+    # ------------------------------------------------------------------
+
+    def account_exists(self, addr: Address) -> bool:
+        return addr in self.accounts
+
+    def get_account(self, addr: Address) -> Optional[Account]:
+        return self.accounts.get(addr)
+
+    def _get_or_create(self, addr: Address) -> Account:
+        acct = self.accounts.get(addr)
+        if acct is None:
+            acct = Account()
+            self.accounts[addr] = acct
+            self._journal.append(("create_account", addr))
+        return acct
+
+    def create_account(self, addr: Address) -> Account:
+        return self._get_or_create(addr)
+
+    def mark_created(self, addr: Address) -> None:
+        """Track contracts created in this tx (EIP-6780-style bookkeeping and
+        EIP-2200 original-value semantics for fresh contracts)."""
+        self.created.add(addr)
+        self._journal.append(("created", addr))
+
+    def delete_account(self, addr: Address) -> None:
+        acct = self.accounts.pop(addr, None)
+        if acct is not None:
+            self._journal.append(("delete_account", addr, acct))
+
+    def is_empty(self, addr: Address) -> bool:
+        acct = self.accounts.get(addr)
+        return acct is None or acct.is_empty()
+
+    # ------------------------------------------------------------------
+    # balances / nonces / code
+    # ------------------------------------------------------------------
+
+    def get_balance(self, addr: Address) -> int:
+        acct = self.accounts.get(addr)
+        return acct.balance if acct else 0
+
+    def set_balance(self, addr: Address, value: int) -> None:
+        acct = self._get_or_create(addr)
+        self._journal.append(("balance", addr, acct.balance))
+        acct.balance = value
+
+    def add_balance(self, addr: Address, delta: int) -> None:
+        self.set_balance(addr, self.get_balance(addr) + delta)
+
+    def sub_balance(self, addr: Address, delta: int) -> None:
+        bal = self.get_balance(addr)
+        if delta > bal:
+            raise ValueError("balance underflow")
+        self.set_balance(addr, bal - delta)
+
+    def get_nonce(self, addr: Address) -> int:
+        acct = self.accounts.get(addr)
+        return acct.nonce if acct else 0
+
+    def set_nonce(self, addr: Address, value: int) -> None:
+        acct = self._get_or_create(addr)
+        self._journal.append(("nonce", addr, acct.nonce))
+        acct.nonce = value
+
+    def increment_nonce(self, addr: Address) -> None:
+        self.set_nonce(addr, self.get_nonce(addr) + 1)
+
+    def get_code(self, addr: Address) -> bytes:
+        acct = self.accounts.get(addr)
+        return acct.code if acct else b""
+
+    def set_code(self, addr: Address, code: bytes) -> None:
+        acct = self._get_or_create(addr)
+        self._journal.append(("code", addr, acct.code))
+        acct.code = code
+
+    # ------------------------------------------------------------------
+    # storage
+    # ------------------------------------------------------------------
+
+    def get_storage(self, addr: Address, slot: int) -> int:
+        acct = self.accounts.get(addr)
+        return acct.storage.get(slot, 0) if acct else 0
+
+    def set_storage(self, addr: Address, slot: int, value: int) -> None:
+        acct = self._get_or_create(addr)
+        current = acct.storage.get(slot, 0)
+        key = (addr, slot)
+        if key not in self._tx_original:
+            self._tx_original[key] = current
+        self._journal.append(("storage", addr, slot, current))
+        if value == 0:
+            acct.storage.pop(slot, None)
+        else:
+            acct.storage[slot] = value
+
+    def get_original_storage(self, addr: Address, slot: int) -> int:
+        """Value at the start of the current tx (EIP-2200; reference keeps a
+        whole-map clone for this, src/state/statedb.zig:22-25)."""
+        key = (addr, slot)
+        if key in self._tx_original:
+            return self._tx_original[key]
+        return self.get_storage(addr, slot)
+
+    # ------------------------------------------------------------------
+    # EIP-2929 warm sets (journaled: reverted scopes re-cool their additions)
+    # ------------------------------------------------------------------
+
+    def access_address(self, addr: Address) -> bool:
+        """Mark warm; returns True if it was already warm."""
+        if addr in self.accessed_addresses:
+            return True
+        self.accessed_addresses.add(addr)
+        self._journal.append(("warm_addr", addr))
+        return False
+
+    def access_storage_key(self, addr: Address, slot: int) -> bool:
+        key = (addr, slot)
+        if key in self.accessed_storage_keys:
+            return True
+        self.accessed_storage_keys.add(key)
+        self._journal.append(("warm_slot", key))
+        return False
+
+    # ------------------------------------------------------------------
+    # touched / selfdestruct / logs / refunds
+    # ------------------------------------------------------------------
+
+    def touch(self, addr: Address) -> None:
+        if addr not in self.touched:
+            self.touched.add(addr)
+            self._journal.append(("touch", addr))
+
+    def mark_selfdestruct(self, addr: Address) -> None:
+        if addr not in self.selfdestructs:
+            self.selfdestructs.add(addr)
+            self._journal.append(("selfdestruct", addr))
+
+    def add_log(self, log: Log) -> None:
+        self.logs.append(log)
+        self._journal.append(("log",))
+
+    def add_refund(self, delta: int) -> None:
+        self._journal.append(("refund", self.refund))
+        self.refund += delta
+        if self.refund < 0:  # pragma: no cover — guarded by EIP-3529 math
+            raise AssertionError("negative refund counter")
+
+    # ------------------------------------------------------------------
+    # finalization
+    # ------------------------------------------------------------------
+
+    def destroy_touched_empty(self) -> None:
+        """EIP-158: remove touched accounts that ended the tx empty
+        (reference: src/blockchain/blockchain.zig:334-341)."""
+        for addr in list(self.touched):
+            acct = self.accounts.get(addr)
+            if acct is not None and acct.is_empty():
+                del self.accounts[addr]
+
+    def state_root(self) -> bytes:
+        return _state_root(self.accounts)
+
+    def copy(self) -> "StateDB":
+        return StateDB({a: acct.copy() for a, acct in self.accounts.items()})

@@ -1,0 +1,254 @@
+"""Hand-written EVM integration tests (reference: src/tests/custom_tests.zig:17-95
+deploys a contract via a CREATE tx then calls it) plus precompile vectors."""
+
+import pytest
+
+from phant_tpu.evm.interpreter import Evm, create_address, create2_address
+from phant_tpu.evm.message import Environment, Message
+from phant_tpu.evm.precompiles import PRECOMPILES
+from phant_tpu.state.statedb import StateDB
+from phant_tpu.types.account import Account
+from phant_tpu.crypto.keccak import keccak256
+
+SENDER = b"\x10" * 20
+OTHER = b"\x20" * 20
+
+
+def _env(state):
+    return Environment(state=state, origin=SENDER, coinbase=b"\xc0" * 20,
+                       block_number=1, timestamp=1000, base_fee=0, gas_price=10)
+
+
+def _prep():
+    state = StateDB({SENDER: Account(balance=10**18)})
+    state.start_tx()
+    return state, Evm(_env(state))
+
+
+def test_create_then_call():
+    # init code: PUSH13 <runtime> PUSH1 0 MSTORE ... return runtime code
+    # runtime: PUSH1 42 PUSH1 0 MSTORE PUSH1 32 PUSH1 0 RETURN  (returns 42)
+    runtime = bytes.fromhex("602a60005260206000f3")
+    # init: push runtime to memory, return it
+    init = (
+        bytes([0x60 + len(runtime) - 1]) + runtime  # PUSHn runtime
+        + bytes.fromhex("600052")  # MSTORE at 0 (right-aligned)
+        + bytes([0x60, len(runtime), 0x60, 32 - len(runtime), 0xF3])  # RETURN
+    )
+    state, evm = _prep()
+    state.increment_nonce(SENDER)  # mimic tx-processing nonce bump
+    result = evm.execute_message(
+        Message(caller=SENDER, target=None, value=0, data=init, gas=200_000)
+    )
+    assert result.success, result.error
+    addr = result.create_address
+    assert addr == create_address(SENDER, 0)
+    assert state.get_code(addr) == runtime
+    assert state.get_nonce(addr) == 1  # EIP-161
+
+    call = evm.execute_message(
+        Message(caller=SENDER, target=addr, value=0, data=b"", gas=100_000)
+    )
+    assert call.success
+    assert int.from_bytes(call.output, "big") == 42
+
+
+def test_create2_address_derivation():
+    assert create2_address(b"\x00" * 20, b"\x00" * 32, b"")[:2] != b"\x00\x00" or True
+    # EIP-1014 example 1: sender 0x0, salt 0, code 0x00
+    addr = create2_address(b"\x00" * 20, b"\x00" * 32, b"\x00")
+    assert addr.hex() == "4d1a2e2bb4f88f0250f26ffff098b0b30b26bf38"
+
+
+def test_sstore_refund_and_revert():
+    contract = OTHER
+    # code: SSTORE(0, 0) on a slot whose original value is 1 -> clears refund
+    code = bytes.fromhex("6000600055")  # PUSH1 0 PUSH1 0 SSTORE
+    state = StateDB({
+        SENDER: Account(balance=10**18),
+        contract: Account(code=code, storage={0: 1}),
+    })
+    state.start_tx()
+    evm = Evm(_env(state))
+    result = evm.execute_message(
+        Message(caller=SENDER, target=contract, value=0, data=b"", gas=100_000)
+    )
+    assert result.success
+    assert state.get_storage(contract, 0) == 0
+    assert state.refund == 4800  # EIP-3529 clear refund
+
+
+def test_static_call_blocks_sstore():
+    contract = OTHER
+    code = bytes.fromhex("600160005500")  # SSTORE(0,1); STOP
+    state = StateDB({SENDER: Account(balance=1), contract: Account(code=code)})
+    state.start_tx()
+    evm = Evm(_env(state))
+    result = evm.execute_message(
+        Message(caller=SENDER, target=contract, value=0, data=b"", gas=100_000,
+                is_static=True)
+    )
+    assert not result.success
+    assert state.get_storage(contract, 0) == 0
+
+
+def test_revert_returns_data_and_restores_state():
+    contract = OTHER
+    # SSTORE(0,1); PUSH1 1 PUSH1 31 MSTORE8... simpler: store then REVERT(0,32)
+    code = bytes.fromhex("600160005560FF60005260206000fd")
+    state = StateDB({SENDER: Account(balance=1), contract: Account(code=code)})
+    state.start_tx()
+    evm = Evm(_env(state))
+    result = evm.execute_message(
+        Message(caller=SENDER, target=contract, value=0, data=b"", gas=100_000)
+    )
+    assert not result.success and result.is_revert
+    assert int.from_bytes(result.output, "big") == 0xFF
+    assert state.get_storage(contract, 0) == 0  # reverted
+    assert result.gas_left > 0  # revert refunds remaining gas
+
+
+def test_out_of_gas_consumes_all():
+    contract = OTHER
+    code = bytes.fromhex("5b600056")  # JUMPDEST PUSH1 0 JUMP — infinite loop
+    state = StateDB({SENDER: Account(balance=1), contract: Account(code=code)})
+    state.start_tx()
+    evm = Evm(_env(state))
+    result = evm.execute_message(
+        Message(caller=SENDER, target=contract, value=0, data=b"", gas=30_000)
+    )
+    assert not result.success
+    assert result.gas_left == 0
+
+
+def test_value_transfer_via_call():
+    state = StateDB({SENDER: Account(balance=1000)})
+    state.start_tx()
+    evm = Evm(_env(state))
+    result = evm.execute_message(
+        Message(caller=SENDER, target=OTHER, value=300, data=b"", gas=50_000)
+    )
+    assert result.success
+    assert state.get_balance(OTHER) == 300
+    assert state.get_balance(SENDER) == 700
+
+
+# --- precompiles ----------------------------------------------------------
+
+
+def _addr(n):
+    return n.to_bytes(20, "big")
+
+
+def test_precompile_sha256_identity_ripemd():
+    out = PRECOMPILES[_addr(2)](b"abc", 10_000)
+    assert out.success
+    import hashlib
+
+    assert out.output == hashlib.sha256(b"abc").digest()
+    out = PRECOMPILES[_addr(4)](b"hello", 10_000)
+    assert out.output == b"hello"
+    out = PRECOMPILES[_addr(3)](b"abc", 10_000)
+    assert out.output.hex().endswith("8eb208f7e05d987a9b044a8e98c6b087f15a0bfc")
+
+
+def test_precompile_ecrecover():
+    # sign with our own signer and recover through the precompile interface
+    from phant_tpu.crypto import secp256k1
+
+    key = 0x1234
+    msg = keccak256(b"precompile test")
+    r, s, y_parity = secp256k1.sign(msg, key)
+    data = (msg + (27 + y_parity).to_bytes(32, "big")
+            + r.to_bytes(32, "big") + s.to_bytes(32, "big"))
+    out = PRECOMPILES[_addr(1)](data, 10_000)
+    assert out.success
+    from phant_tpu.signer.signer import address_from_pubkey
+
+    expect = address_from_pubkey(secp256k1.pubkey_of(key))
+    assert out.output[-20:] == expect
+    # garbage v -> empty output, still success
+    bad = PRECOMPILES[_addr(1)](msg + (99).to_bytes(32, "big") + data[64:], 10_000)
+    assert bad.success and bad.output == b""
+
+
+def test_precompile_modexp():
+    # 3^5 mod 7 = 5
+    data = ((1).to_bytes(32, "big") + (1).to_bytes(32, "big") + (1).to_bytes(32, "big")
+            + b"\x03" + b"\x05" + b"\x07")
+    out = PRECOMPILES[_addr(5)](data, 10_000)
+    assert out.success
+    assert out.output == b"\x05"
+
+
+def test_precompile_blake2f_vector():
+    # EIP-152 test vector 5 (12 rounds, "abc" state)
+    data = bytes.fromhex(
+        "0000000c"
+        "48c9bdf267e6096a3ba7ca8485ae67bb2bf894fe72f36e3cf1361d5f3af54fa5"
+        "d182e6ad7f520e511f6c3e2b8c68059b6bbd41fbabd9831f79217e1319cde05b"
+        "6162630000000000000000000000000000000000000000000000000000000000"
+        "0000000000000000000000000000000000000000000000000000000000000000"
+        "0000000000000000000000000000000000000000000000000000000000000000"
+        "0000000000000000000000000000000000000000000000000000000000000000"
+        "0300000000000000" "0000000000000000" "01"
+    )
+    out = PRECOMPILES[_addr(9)](data, 100)
+    assert out.success
+    assert out.output.hex() == (
+        "ba80a53f981c4d0d6a2797b69f12f6e94c212f14685ac4b74b12bb6fdbffa2d1"
+        "7d87c5392aab792dc252d5de4533cc9518d38aa8dbf1925ab92386edd4009923"
+    )
+
+
+def test_precompile_bn254_add_mul():
+    g1 = (1).to_bytes(32, "big") + (2).to_bytes(32, "big")
+    out = PRECOMPILES[_addr(6)](g1 + g1, 10_000)
+    assert out.success
+    two_g = out.output
+    out2 = PRECOMPILES[_addr(7)](g1 + (2).to_bytes(32, "big"), 10_000)
+    assert out2.success
+    assert out2.output == two_g
+
+
+def test_delegatecall_moves_no_funds():
+    # A delegatecalls B while carrying the parent call's value: no transfer
+    lib = b"\x30" * 20
+    proxy = OTHER
+    # proxy: DELEGATECALL(gas, lib, 0, 0, 0, 0); STOP
+    code = (bytes.fromhex("6000600060006000") + b"\x73" + lib
+            + bytes.fromhex("61fffff400"))
+    state = StateDB({
+        SENDER: Account(balance=1000),
+        proxy: Account(code=code),
+        lib: Account(code=b"\x00"),  # STOP
+    })
+    state.start_tx()
+    evm = Evm(_env(state))
+    result = evm.execute_message(
+        Message(caller=SENDER, target=proxy, value=500, data=b"", gas=200_000)
+    )
+    assert result.success, result.error
+    # value moved exactly once (sender -> proxy), never again on delegatecall
+    assert state.get_balance(SENDER) == 500
+    assert state.get_balance(proxy) == 500
+    assert state.get_balance(lib) == 0
+
+
+def test_truncated_push_zero_extends():
+    # code ends mid-PUSH2: missing immediate bytes read as zeros -> 0xAA00
+    contract = OTHER
+    code = bytes.fromhex("61AA")  # PUSH2 with one byte of immediate
+    state = StateDB({SENDER: Account(balance=1), contract: Account(code=code)})
+    state.start_tx()
+    evm = Evm(_env(state))
+    # run the frame directly to inspect the stack
+    from phant_tpu.evm.interpreter import Frame, valid_jumpdests
+
+    frame = Frame(
+        msg=Message(caller=SENDER, target=contract, value=0, data=b"", gas=100),
+        code=code, gas=100, address=contract, jumpdests=valid_jumpdests(code),
+    )
+    result = evm._run(frame)
+    assert result.success
+    assert frame.stack == [0xAA00]

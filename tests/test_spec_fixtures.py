@@ -1,0 +1,25 @@
+"""The backbone: official ethereum/execution-spec-tests blockchain fixtures
+(reference: src/tests/spec_tests.zig:170-194). Each fixture carries its own
+oracle (post-state, lastblockhash); one parametrized test per fixture."""
+
+from pathlib import Path
+
+import pytest
+
+from phant_tpu.spec.fixtures import walk_fixtures
+from phant_tpu.spec.runner import run_fixture
+
+FIXTURES = Path(__file__).parent / "fixtures"
+
+ALL = [(p.name, fx) for p, fx in walk_fixtures(FIXTURES)]
+
+
+@pytest.mark.parametrize(
+    "fixture", [fx for _, fx in ALL], ids=[f"{n}::{fx.name}" for n, fx in ALL]
+)
+def test_spec_fixture(fixture):
+    run_fixture(fixture)
+
+
+def test_fixture_count():
+    assert len(ALL) >= 80  # 20 Shanghai files, several fork variants each
