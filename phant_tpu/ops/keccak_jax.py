@@ -1,0 +1,206 @@
+"""Batched keccak256 on TPU via JAX (bit-sliced, u32 lane pairs).
+
+This is the device half of the crypto hot loop (BASELINE.md config #2):
+keccak256 over thousands of variable-length payloads at once. TPUs have no
+64-bit integer lanes, so each Keccak lane is a (lo, hi) pair of uint32
+vectors of shape (B,); the whole f[1600] permutation is unrolled (static
+rotations become shifts XLA fuses into a single elementwise program).
+
+Variable lengths are handled host-side by padding into a fixed number of
+136-byte rate chunks (`pack_payloads`); absorption of chunk c is masked per
+instance by `c < nchunks`, so one compiled program serves every payload
+length up to the bucket bound. Differential-tested bit-exactly against the
+CPU backends (tests/test_keccak_jax.py).
+
+Reference scope equivalence: src/crypto/hasher.zig:4-17 (scalar CPU hashing)
+— the batching axis is this framework's addition per the north star.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import List, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from phant_tpu.crypto.keccak import RATE, _KECCAK_RC as _RC
+
+RATE_WORDS = RATE // 8  # 17 lanes absorbed per chunk
+
+# rotation offset for lane x+5y (same table as native/keccak.cc kRot)
+_ROT = [
+    0, 1, 62, 28, 27,
+    36, 44, 6, 55, 20,
+    3, 10, 43, 25, 39,
+    41, 45, 15, 21, 8,
+    18, 2, 61, 56, 14,
+]
+
+
+def _rotl64(lo, hi, r: int):
+    """Rotate a 64-bit lane stored as (lo, hi) u32 pair by static r."""
+    r %= 64
+    if r == 0:
+        return lo, hi
+    if r == 32:
+        return hi, lo
+    if r < 32:
+        nlo = (lo << r) | (hi >> (32 - r))
+        nhi = (hi << r) | (lo >> (32 - r))
+        return nlo, nhi
+    r -= 32
+    nlo = (hi << r) | (lo >> (32 - r))
+    nhi = (lo << r) | (hi >> (32 - r))
+    return nlo, nhi
+
+
+_RC_LO = np.array([rc & 0xFFFFFFFF for rc in _RC], dtype=np.uint32)
+_RC_HI = np.array([rc >> 32 for rc in _RC], dtype=np.uint32)
+
+
+def _keccak_round(lo: List, hi: List, rc_lo, rc_hi) -> Tuple[List, List]:
+    """One Keccak-f round; rotations are static, the round constant is traced."""
+    # theta
+    clo = [lo[x] ^ lo[x + 5] ^ lo[x + 10] ^ lo[x + 15] ^ lo[x + 20] for x in range(5)]
+    chi_ = [hi[x] ^ hi[x + 5] ^ hi[x + 10] ^ hi[x + 15] ^ hi[x + 20] for x in range(5)]
+    for x in range(5):
+        r1lo, r1hi = _rotl64(clo[(x + 1) % 5], chi_[(x + 1) % 5], 1)
+        dlo = clo[(x - 1) % 5] ^ r1lo
+        dhi = chi_[(x - 1) % 5] ^ r1hi
+        for y in range(5):
+            lo[x + 5 * y] = lo[x + 5 * y] ^ dlo
+            hi[x + 5 * y] = hi[x + 5 * y] ^ dhi
+    # rho + pi
+    blo = [None] * 25
+    bhi = [None] * 25
+    for x in range(5):
+        for y in range(5):
+            src = x + 5 * y
+            dst = y + 5 * ((2 * x + 3 * y) % 5)
+            blo[dst], bhi[dst] = _rotl64(lo[src], hi[src], _ROT[src])
+    # chi
+    for y in range(5):
+        row_lo = [blo[x + 5 * y] for x in range(5)]
+        row_hi = [bhi[x + 5 * y] for x in range(5)]
+        for x in range(5):
+            lo[x + 5 * y] = row_lo[x] ^ (~row_lo[(x + 1) % 5] & row_lo[(x + 2) % 5])
+            hi[x + 5 * y] = row_hi[x] ^ (~row_hi[(x + 1) % 5] & row_hi[(x + 2) % 5])
+    # iota
+    lo[0] = lo[0] ^ rc_lo
+    hi[0] = hi[0] ^ rc_hi
+    return lo, hi
+
+
+def keccak_f1600_loop(lo: List, hi: List) -> Tuple[List, List]:
+    """f[1600] as a fori_loop over rounds (compiles 24x smaller than unrolled)."""
+    rc_lo = jnp.asarray(_RC_LO)
+    rc_hi = jnp.asarray(_RC_HI)
+
+    def body(rnd, carry):
+        lo_t, hi_t = carry
+        nlo, nhi = _keccak_round(list(lo_t), list(hi_t), rc_lo[rnd], rc_hi[rnd])
+        return (tuple(nlo), tuple(nhi))
+
+    lo_t, hi_t = jax.lax.fori_loop(0, 24, body, (tuple(lo), tuple(hi)))
+    return list(lo_t), list(hi_t)
+
+
+@functools.partial(jax.jit, static_argnames=("max_chunks",))
+def keccak256_chunked(words: jax.Array, nchunks: jax.Array, *, max_chunks: int) -> jax.Array:
+    """Batched keccak256.
+
+    Args:
+      words: (B, max_chunks, 34) uint32 — payloads already keccak-padded and
+        split into 136-byte rate chunks, little-endian u32 words.
+      nchunks: (B,) int32 — number of real chunks per instance (>=1).
+      max_chunks: static bucket bound.
+
+    Returns:
+      (B, 8) uint32 — digests as little-endian u32 words.
+    """
+    B = words.shape[0]
+    zeros = jnp.zeros((B,), jnp.uint32)
+    lo = [zeros] * 25
+    hi = [zeros] * 25
+    for c in range(max_chunks):
+        live = nchunks > c  # (B,) — instances still absorbing at chunk c
+        # absorb chunk c where live
+        new_lo = list(lo)
+        new_hi = list(hi)
+        for i in range(RATE_WORDS):
+            new_lo[i] = lo[i] ^ words[:, c, 2 * i]
+            new_hi[i] = hi[i] ^ words[:, c, 2 * i + 1]
+        new_lo, new_hi = keccak_f1600_loop(new_lo, new_hi)
+        lo = [jnp.where(live, n, o) for n, o in zip(new_lo, lo)]
+        hi = [jnp.where(live, n, o) for n, o in zip(new_hi, hi)]
+    out = []
+    for i in range(4):
+        out.append(lo[i])
+        out.append(hi[i])
+    return jnp.stack(out, axis=1)
+
+
+# ---------------------------------------------------------------------------
+# host-side packing
+# ---------------------------------------------------------------------------
+
+
+def pad_payload(data: bytes, nchunks: int) -> bytes:
+    """Keccak multi-rate padding into exactly nchunks rate blocks."""
+    total = nchunks * RATE
+    padded = bytearray(total)
+    padded[: len(data)] = data
+    padded[len(data)] ^= 0x01
+    padded[total - 1] ^= 0x80
+    return bytes(padded)
+
+
+def chunks_for_len(n: int) -> int:
+    """Chunks needed for an n-byte payload (padding always adds >=1 bit)."""
+    return n // RATE + 1
+
+
+def pack_payloads(
+    payloads: Sequence[bytes], max_chunks: int | None = None
+) -> Tuple[np.ndarray, np.ndarray, int]:
+    """Pack variable-length payloads into the fixed-shape device layout.
+
+    Returns (words (B, C, 34) u32, nchunks (B,) i32, C)."""
+    B = len(payloads)
+    need = [chunks_for_len(len(p)) for p in payloads]
+    if max_chunks is not None:
+        C = max_chunks
+    else:
+        # round the bucket up to a power of two so repeated ad-hoc calls hit a
+        # small set of compiled shapes instead of retracing per max length
+        worst = max(need, default=1)
+        C = 1
+        while C < worst:
+            C *= 2
+    if max(need, default=1) > C:
+        raise ValueError(f"payload needs {max(need)} chunks > bucket bound {C}")
+    buf = np.zeros((B, C * RATE), dtype=np.uint8)
+    nchunks = np.zeros((B,), dtype=np.int32)
+    for i, p in enumerate(payloads):
+        k = chunks_for_len(len(p))
+        nchunks[i] = k
+        buf[i, : k * RATE] = np.frombuffer(pad_payload(p, k), dtype=np.uint8)
+    words = buf.reshape(B, C, RATE).view(np.uint32).reshape(B, C, 34)
+    return words, nchunks, C
+
+
+def digests_to_bytes(digests: np.ndarray) -> List[bytes]:
+    """(B, 8) u32 LE words -> list of 32-byte digests."""
+    arr = np.asarray(digests, dtype="<u4")
+    return [arr[i].tobytes() for i in range(arr.shape[0])]
+
+
+def keccak256_batch_jax(payloads: Sequence[bytes], max_chunks: int | None = None) -> List[bytes]:
+    """Convenience end-to-end helper (host pack -> device hash -> bytes)."""
+    if not payloads:
+        return []
+    words, nchunks, C = pack_payloads(payloads, max_chunks)
+    out = keccak256_chunked(jnp.asarray(words), jnp.asarray(nchunks), max_chunks=C)
+    return digests_to_bytes(np.asarray(out))

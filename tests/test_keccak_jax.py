@@ -1,0 +1,52 @@
+"""Differential tests: TPU/JAX keccak vs CPU backends, bit-exact."""
+
+import os
+import random
+
+import numpy as np
+import pytest
+
+from phant_tpu.crypto.keccak import keccak256, keccak256_batch
+from phant_tpu.ops.keccak_jax import (
+    chunks_for_len,
+    keccak256_batch_jax,
+    pack_payloads,
+)
+
+
+def test_known_vectors():
+    assert keccak256_batch_jax([b""])[0] == keccak256(b"")
+    assert keccak256_batch_jax([b"abc"])[0] == keccak256(b"abc")
+
+
+@pytest.mark.parametrize("n", [0, 1, 31, 32, 135, 136, 137, 271, 272, 544, 576])
+def test_lengths_match_cpu(n):
+    data = os.urandom(n)
+    assert keccak256_batch_jax([data])[0] == keccak256(data)
+
+
+def test_mixed_batch():
+    rng = random.Random(7)
+    payloads = [os.urandom(rng.randint(0, 576)) for _ in range(257)]
+    assert keccak256_batch_jax(payloads) == keccak256_batch(payloads)
+
+
+def test_bucket_bound_enforced():
+    with pytest.raises(ValueError):
+        keccak256_batch_jax([b"x" * 1000], max_chunks=2)
+
+
+def test_chunks_for_len_boundaries():
+    assert chunks_for_len(0) == 1
+    assert chunks_for_len(135) == 1
+    assert chunks_for_len(136) == 2  # padding needs a new block
+    assert chunks_for_len(271) == 2
+    assert chunks_for_len(272) == 3
+
+
+def test_pack_payloads_layout():
+    words, nchunks, C = pack_payloads([b"", b"y" * 200])
+    assert words.shape == (2, 2, 34) and C == 2
+    assert list(nchunks) == [1, 2]
+    # first byte of padding for empty payload: 0x01 at offset 0
+    assert words[0, 0, 0] & 0xFF == 0x01
