@@ -1,0 +1,150 @@
+"""Benchmark: mainnet-shaped block-witness verification throughput.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+The workload is BASELINE.md config #3/#5 shaped: for each synthetic block,
+a multiproof witness (touched accounts of a state trie) is verified —
+every witness node keccak256-hashed and the block's expected root checked
+for membership. The baseline is the CPU backend (native C++ keccak via
+ctypes; reference-equivalent scope: src/crypto/hasher.zig +
+src/mpt/mpt.zig). The measured path ships each batch's raw witness bytes
+to the device and runs unpack + pad + hash + verdict fused on device
+(phant_tpu/ops/witness_jax.py), with several batches in flight to hide
+dispatch latency. Timed region is end-to-end per batch: host blob layout,
+transfer, device compute, verdict readback.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+from phant_tpu.ops.witness_jax import WITNESS_MAX_CHUNKS as MAX_CHUNKS
+
+
+def build_witnesses(n_blocks: int, accounts_per_block: int, trie_size: int):
+    """Synthetic state trie + per-block multiproof witnesses."""
+    from phant_tpu import rlp
+    from phant_tpu.crypto.keccak import keccak256
+    from phant_tpu.mpt.mpt import Trie
+    from phant_tpu.mpt.proof import generate_proof
+
+    rng = np.random.default_rng(7)
+    trie = Trie()
+    keys = []
+    for _ in range(trie_size):
+        addr = rng.bytes(20)
+        key = keccak256(addr)
+        leaf = rlp.encode(
+            [
+                rlp.encode_uint(int(rng.integers(0, 1000))),
+                rlp.encode_uint(int(rng.integers(0, 10**18))),
+                rng.bytes(32),
+                rng.bytes(32),
+            ]
+        )
+        trie.put(key, leaf)
+        keys.append(key)
+    root = trie.root_hash()
+
+    witnesses = []
+    for _ in range(n_blocks):
+        idx = rng.choice(len(keys), size=accounts_per_block, replace=False)
+        nodes: dict = {}
+        for i in idx:
+            for n in generate_proof(trie, keys[i]):
+                nodes[n] = None
+        witnesses.append((root, list(nodes.keys())))
+    return witnesses
+
+
+def verify_cpu(witnesses) -> int:
+    """CPU baseline: hash every witness node with the native keccak backend,
+    check root membership; returns number of verified blocks."""
+    from phant_tpu.crypto.keccak import keccak256_batch
+
+    ok = 0
+    for root, nodes in witnesses:
+        if root in set(keccak256_batch(nodes)):
+            ok += 1
+    return ok
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+
+    from phant_tpu.ops.witness_jax import (
+        pack_witness_blob,
+        roots_to_words,
+        witness_verify,
+    )
+
+    # 64 blocks x ~100 nodes = 8192 padded nodes per dispatch: the measured
+    # sweet spot (larger gathers fall off a fast path on the current chip)
+    n_blocks, accounts, trie_size = 64, 32, 4096
+    witnesses = build_witnesses(n_blocks, accounts, trie_size)
+    node_lists = [nodes for _root, nodes in witnesses]
+    roots = roots_to_words([root for root, _nodes in witnesses])
+
+    # --- CPU baseline (best of 3 to shrug off machine noise) ---------------
+    verify_cpu(witnesses[:4])  # warm the native lib
+    cpu_s = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        ok_cpu = verify_cpu(witnesses)
+        cpu_s = min(cpu_s, time.perf_counter() - t0)
+        assert ok_cpu == n_blocks
+    cpu_rate = n_blocks / cpu_s
+
+    # --- device path -------------------------------------------------------
+    _, meta0 = pack_witness_blob(node_lists, MAX_CHUNKS)
+    pad_nodes = meta0.shape[1]  # stable compiled shape across batches
+    roots_d = jnp.asarray(roots)
+
+    def dispatch():
+        """Full per-batch pipeline: blob layout -> transfer -> fused device
+        unpack+pad+hash+verdict. Returns the in-flight device verdict."""
+        blob, meta = pack_witness_blob(node_lists, MAX_CHUNKS, pad_nodes_to=pad_nodes)
+        return witness_verify(
+            jnp.asarray(blob),
+            jnp.asarray(meta),
+            roots_d,
+            max_chunks=MAX_CHUNKS,
+            n_blocks=n_blocks,
+        )
+
+    dispatch().block_until_ready()  # compile
+    reps = 20
+    t0 = time.perf_counter()
+    in_flight = [dispatch() for _ in range(reps)]
+    for out in in_flight:
+        out.block_until_ready()
+    dev_s = (time.perf_counter() - t0) / reps
+    ok_dev = int(np.asarray(in_flight[-1]).sum())
+    assert ok_dev == n_blocks, f"device verified {ok_dev}/{n_blocks}"
+
+    dev_rate = n_blocks / dev_s
+    print(
+        json.dumps(
+            {
+                "metric": "block_witness_verifications_per_sec",
+                "value": round(dev_rate, 2),
+                "unit": "blocks/s",
+                "vs_baseline": round(dev_rate / cpu_rate, 2),
+                "detail": {
+                    "backend": jax.devices()[0].platform,
+                    "cpu_baseline_blocks_per_sec": round(cpu_rate, 2),
+                    "nodes_per_block": round(
+                        sum(len(n) for n in node_lists) / n_blocks, 1
+                    ),
+                },
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,40 @@
+// Host-side device-buffer packer — the C-ABI shim of the north star
+// (BASELINE.json: "The Zig side packs variable-length trie paths and node
+// RLP into padded device buffers"; the reference's analogous native glue is
+// src/glue.c). Pads variable-length payloads with keccak multi-rate padding
+// and lays them out as the fixed-shape (B, C, 136-byte) chunk buffer the
+// device keccak kernel (phant_tpu/ops/keccak_jax.py) consumes.
+
+#include <cstdint>
+#include <cstring>
+#include <cstddef>
+
+namespace {
+constexpr size_t kRate = 136;
+}
+
+extern "C" {
+
+// Pack payload i = in[offsets[i] .. offsets[i]+lens[i]) into
+// out[i * max_chunks * kRate ...], keccak-padded into nchunks[i] rate blocks.
+// out must be zero-initialised to B * max_chunks * kRate bytes by the caller
+// (numpy allocates it zeroed). Returns 0 on success, -1 if any payload
+// overflows the bucket bound.
+int phant_pack_keccak(const uint8_t* in, const uint64_t* offsets,
+                      const uint32_t* lens, size_t n, size_t max_chunks,
+                      uint8_t* out, int32_t* nchunks) {
+  const size_t row = max_chunks * kRate;
+  for (size_t i = 0; i < n; ++i) {
+    const size_t len = lens[i];
+    const size_t k = len / kRate + 1;  // padding always adds >= 1 bit
+    if (k > max_chunks) return -1;
+    nchunks[i] = static_cast<int32_t>(k);
+    uint8_t* dst = out + i * row;
+    std::memcpy(dst, in + offsets[i], len);
+    dst[len] ^= 0x01;
+    dst[k * kRate - 1] ^= 0x80;
+  }
+  return 0;
+}
+
+}  // extern "C"

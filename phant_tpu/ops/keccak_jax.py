@@ -120,8 +120,10 @@ def keccak256_chunked(words: jax.Array, nchunks: jax.Array, *, max_chunks: int) 
     Returns:
       (B, 8) uint32 — digests as little-endian u32 words.
     """
-    B = words.shape[0]
-    zeros = jnp.zeros((B,), jnp.uint32)
+    # derive the zero state from the input so it inherits the input's
+    # varying-manual-axes under shard_map (a fresh constant would be
+    # replicated and break the fori_loop carry typing)
+    zeros = words[:, 0, 0] ^ words[:, 0, 0]
     lo = [zeros] * 25
     hi = [zeros] * 25
     for c in range(max_chunks):
@@ -181,12 +183,19 @@ def pack_payloads(
             C *= 2
     if max(need, default=1) > C:
         raise ValueError(f"payload needs {max(need)} chunks > bucket bound {C}")
-    buf = np.zeros((B, C * RATE), dtype=np.uint8)
-    nchunks = np.zeros((B,), dtype=np.int32)
-    for i, p in enumerate(payloads):
-        k = chunks_for_len(len(p))
-        nchunks[i] = k
-        buf[i, : k * RATE] = np.frombuffer(pad_payload(p, k), dtype=np.uint8)
+    from phant_tpu.utils.native import load_native
+
+    native = load_native()
+    if native is not None:
+        # native C-ABI packer (the new framework's glue.c equivalent)
+        buf, nchunks = native.pack_keccak(payloads, C)
+    else:
+        buf = np.zeros((B, C * RATE), dtype=np.uint8)
+        nchunks = np.zeros((B,), dtype=np.int32)
+        for i, p in enumerate(payloads):
+            k = chunks_for_len(len(p))
+            nchunks[i] = k
+            buf[i, : k * RATE] = np.frombuffer(pad_payload(p, k), dtype=np.uint8)
     words = buf.reshape(B, C, RATE).view(np.uint32).reshape(B, C, 34)
     return words, nchunks, C
 

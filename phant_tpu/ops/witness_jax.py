@@ -1,0 +1,150 @@
+"""Fused block-witness verification on device.
+
+The device program receives exactly the bytes a stateless client receives —
+the concatenated RLP witness nodes (blob) plus tiny metadata — and does
+everything else on device: unpack each node from the blob (gather),
+keccak-pad it, hash it with the batched keccak kernel, and reduce a
+per-block verdict (does some node hash to the block's expected root?).
+Host->device traffic is therefore the witness itself, not a padded layout
+(~4x smaller, and no host-side packing loop at all).
+
+Reference scope: the keccak/MPT hot loop (src/crypto/hasher.zig:4-17,
+src/mpt/mpt.zig:38-119); the batching axis and the on-device verdict are
+this framework's addition per the north star (BASELINE.json).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import List, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from phant_tpu.crypto.keccak import RATE
+from phant_tpu.ops.keccak_jax import keccak256_chunked
+
+# Bucket bound for witness nodes: RLP trie nodes are <= 576B (BASELINE.md),
+# and 576 < 5 * 136. Shared by bench.py / __graft_entry__.py / tests.
+WITNESS_MAX_CHUNKS = 5
+
+
+@functools.partial(jax.jit, static_argnames=("max_chunks",))
+def witness_digests(
+    blob: jax.Array,
+    offsets: jax.Array,
+    lens: jax.Array,
+    *,
+    max_chunks: int,
+) -> jax.Array:
+    """Hash every node sliced out of `blob` on device.
+
+    Args:
+      blob: (L,) uint8 — concatenated node payloads, L >= max offset+len and
+        padded with at least max_chunks*RATE trailing zeros (gather slack).
+      offsets: (B,) int32 — start of node i in blob.
+      lens: (B,) int32 — byte length of node i (0 = padding row).
+      max_chunks: static bucket bound (rate chunks per node).
+
+    Returns:
+      (B, 8) uint32 digests (little-endian words).
+    """
+    row = max_chunks * RATE
+    pos = jnp.arange(row, dtype=jnp.int32)[None, :]  # (1, row)
+    idx = offsets[:, None] + pos  # (B, row)
+    data = jnp.take(blob, idx, mode="clip")
+    in_range = pos < lens[:, None]
+    data = jnp.where(in_range, data, jnp.uint8(0))
+    # keccak multi-rate padding: 0x01 after the payload, 0x80 at the end of
+    # the last rate block
+    nchunks = lens // RATE + 1
+    pad01 = (pos == lens[:, None]).astype(jnp.uint8)
+    pad80 = (pos == nchunks[:, None] * RATE - 1).astype(jnp.uint8) << 7
+    data = data ^ pad01 ^ pad80
+    # u8 -> little-endian u32 lanes
+    b = data.reshape(data.shape[0], max_chunks, RATE // 4, 4).astype(jnp.uint32)
+    words = b[..., 0] | (b[..., 1] << 8) | (b[..., 2] << 16) | (b[..., 3] << 24)
+    return keccak256_chunked(words, nchunks, max_chunks=max_chunks)
+
+
+@functools.partial(jax.jit, static_argnames=("max_chunks", "n_blocks"))
+def witness_verify(
+    blob: jax.Array,
+    meta: jax.Array,
+    roots: jax.Array,
+    *,
+    max_chunks: int,
+    n_blocks: int,
+) -> jax.Array:
+    """Per-block root-membership verdict, entirely on device.
+
+    meta: (3, B) int32 — rows are (offsets, lens, block_id); fused into one
+      array so a batch costs two host->device transfers (blob + meta), not
+      four dispatches.
+    roots: (n_blocks, 8) uint32 — expected state/trie root per block.
+
+    Returns (n_blocks,) bool — block b is verified iff some node of block b
+    hashes to roots[b]. (Linkage of inner nodes is checked by the host walk
+    in phant_tpu/mpt/proof.py; this kernel covers the hashing-dominated
+    membership check, the hot 90%.)
+    """
+    offsets, lens, block_id = meta[0], meta[1], meta[2]
+    digests = witness_digests(blob, offsets, lens, max_chunks=max_chunks)
+    return partial_verdict(digests, lens, block_id, roots, n_blocks) > 0
+
+
+def partial_verdict(digests, lens, block_id, roots, n_blocks: int):
+    """(n_blocks,) int32 root-membership hits for one shard of nodes.
+
+    Shared by the single-chip path above and the dp-sharded path
+    (__graft_entry__.dryrun_multichip), which pmax-combines shards' results
+    over the mesh — keeping verdict semantics in exactly one place."""
+    valid = lens > 0
+    is_root = jnp.all(digests == roots[block_id], axis=1) & valid
+    return jnp.zeros((n_blocks,), jnp.int32).at[block_id].max(is_root.astype(jnp.int32))
+
+
+# ---------------------------------------------------------------------------
+# host-side layout
+# ---------------------------------------------------------------------------
+
+
+def pack_witness_blob(
+    node_lists: Sequence[Sequence[bytes]], max_chunks: int, pad_nodes_to: int | None = None
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Concatenate per-block node lists into (blob, meta) where meta is the
+    (3, B) int32 array of (offsets, lens, block_id) rows.
+
+    The blob gets max_chunks*RATE trailing zeros of gather slack; the node
+    axis is padded to `pad_nodes_to` (default: next power of two) with
+    zero-length rows so repeated calls reuse a small set of compiled shapes.
+    """
+    parts: List[bytes] = [n for nodes in node_lists for n in nodes]
+    B = len(parts)
+    counts = np.fromiter((len(nodes) for nodes in node_lists), np.int64, len(node_lists))
+    lens_arr = np.fromiter((len(n) for n in parts), np.int32, B)
+    if int(lens_arr.sum()) >= 2**31:
+        raise ValueError("witness blob exceeds int32 offset range; split the batch")
+    if B and (lens_arr // RATE + 1 > max_chunks).any():
+        worst = int(lens_arr.max())
+        raise ValueError(f"node of {worst}B exceeds bucket bound {max_chunks}")
+    target = pad_nodes_to
+    if target is None:
+        target = 1
+        while target < max(B, 1):
+            target *= 2
+    if B > target:
+        raise ValueError(f"{B} nodes exceed pad_nodes_to={target}")
+    meta = np.zeros((3, target), np.int32)
+    if B > 1:
+        np.cumsum(lens_arr[:-1], out=meta[0, 1:B])
+    meta[1, :B] = lens_arr
+    meta[2, :B] = np.repeat(np.arange(len(node_lists), dtype=np.int32), counts)
+    blob = np.frombuffer(b"".join(parts) + b"\x00" * (max_chunks * RATE), dtype=np.uint8)
+    return blob, meta
+
+
+def roots_to_words(roots: Sequence[bytes]) -> np.ndarray:
+    """(NB, 8) u32 little-endian view of 32-byte root hashes."""
+    return np.stack([np.frombuffer(r, dtype="<u4") for r in roots])

@@ -69,16 +69,26 @@ class NativeLib:
             ctypes.c_char_p,
         ]
         lib.phant_keccak256_batch.restype = None
+        lib.phant_pack_keccak.argtypes = [
+            ctypes.c_char_p,
+            ctypes.POINTER(ctypes.c_uint64),
+            ctypes.POINTER(ctypes.c_uint32),
+            ctypes.c_size_t,
+            ctypes.c_size_t,
+            ctypes.c_void_p,
+            ctypes.c_void_p,
+        ]
+        lib.phant_pack_keccak.restype = ctypes.c_int
 
     def keccak256(self, data: bytes) -> bytes:
         out = ctypes.create_string_buffer(32)
         self._lib.phant_keccak256(data, len(data), out)
         return out.raw
 
-    def keccak256_batch(self, payloads: Sequence[bytes]) -> List[bytes]:
+    @staticmethod
+    def _layout(payloads: Sequence[bytes]):
+        """Concatenate payloads and build the C-ABI (blob, offsets, lens)."""
         n = len(payloads)
-        if n == 0:
-            return []
         blob = b"".join(payloads)
         offsets = (ctypes.c_uint64 * n)()
         lens = (ctypes.c_uint32 * n)()
@@ -87,6 +97,37 @@ class NativeLib:
             offsets[i] = pos
             lens[i] = len(p)
             pos += len(p)
+        return blob, offsets, lens
+
+    def pack_keccak(self, payloads: Sequence[bytes], max_chunks: int):
+        """Pad+chunk payloads into the device keccak layout.
+
+        Returns (buf (B, max_chunks*136) u8 ndarray, nchunks (B,) i32 ndarray);
+        the caller reshapes/views into (B, C, 34) u32 words."""
+        import numpy as np
+
+        n = len(payloads)
+        blob, offsets, lens = self._layout(payloads)
+        buf = np.zeros((n, max_chunks * 136), dtype=np.uint8)
+        nchunks = np.zeros((n,), dtype=np.int32)
+        rc = self._lib.phant_pack_keccak(
+            blob,
+            offsets,
+            lens,
+            n,
+            max_chunks,
+            buf.ctypes.data_as(ctypes.c_void_p),
+            nchunks.ctypes.data_as(ctypes.c_void_p),
+        )
+        if rc != 0:
+            raise ValueError(f"payload exceeds bucket bound {max_chunks}")
+        return buf, nchunks
+
+    def keccak256_batch(self, payloads: Sequence[bytes]) -> List[bytes]:
+        n = len(payloads)
+        if n == 0:
+            return []
+        blob, offsets, lens = self._layout(payloads)
         out = ctypes.create_string_buffer(32 * n)
         self._lib.phant_keccak256_batch(blob, offsets, lens, n, out)
         raw = out.raw

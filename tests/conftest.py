@@ -2,13 +2,30 @@
 
 Multi-chip hardware is unavailable in CI; sharding tests run on a virtual
 CPU mesh (the driver separately validates the multi-chip path via
-__graft_entry__.dryrun_multichip)."""
+__graft_entry__.dryrun_multichip).
+
+The environment may pre-set JAX_PLATFORMS=axon and PALLAS_AXON_POOL_IPS to
+route jax at a single tunneled TPU chip; both must be overridden (not
+defaulted) or every test runs over the network against one real chip and
+meshes collapse to a single device. Set PHANT_TEST_TPU=1 to run the suite
+against the real chip instead (hardware validation of the device kernels).
+"""
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
+if os.environ.get("PHANT_TEST_TPU", "0") in ("", "0"):
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8"
+        ).strip()
+
+    # the axon sitecustomize calls jax.config.update("jax_platforms",
+    # "axon,cpu") at interpreter startup, which outranks the env var —
+    # override the config itself (backends initialize lazily, so this is
+    # still early enough)
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")

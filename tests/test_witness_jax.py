@@ -1,0 +1,109 @@
+"""Differential tests: fused device witness pipeline vs the CPU oracle
+(phant_tpu/mpt/proof.py + CPU keccak)."""
+
+import jax.numpy as jnp
+import numpy as np
+
+from phant_tpu import rlp
+from phant_tpu.crypto.keccak import keccak256
+from phant_tpu.mpt.mpt import Trie
+from phant_tpu.mpt.proof import generate_proof, verify_witness
+from phant_tpu.ops.witness_jax import (
+    WITNESS_MAX_CHUNKS as MAX_CHUNKS,
+    pack_witness_blob,
+    roots_to_words,
+    witness_digests,
+    witness_verify,
+)
+
+
+def _trie_with_proofs(n_keys=64, touched=8, seed=3):
+    rng = np.random.default_rng(seed)
+    trie = Trie()
+    keys = []
+    for _ in range(n_keys):
+        key = keccak256(rng.bytes(20))
+        trie.put(key, rlp.encode(rng.bytes(40)))
+        keys.append(key)
+    root = trie.root_hash()
+    idx = rng.choice(n_keys, size=touched, replace=False)
+    nodes: dict = {}
+    entries = []
+    for i in idx:
+        for n in generate_proof(trie, keys[i]):
+            nodes[n] = None
+        entries.append((keys[i], trie.get(keys[i])))
+    return root, entries, list(nodes.keys())
+
+
+def test_witness_digests_match_cpu():
+    rng = np.random.default_rng(0)
+    payloads = [rng.bytes(int(rng.integers(1, MAX_CHUNKS * 136))) for _ in range(33)]
+    blob, meta = pack_witness_blob([payloads], MAX_CHUNKS)
+    got = np.asarray(
+        witness_digests(
+            jnp.asarray(blob),
+            jnp.asarray(meta[0]),
+            jnp.asarray(meta[1]),
+            max_chunks=MAX_CHUNKS,
+        )
+    )
+    exp = np.stack([np.frombuffer(keccak256(p), "<u4") for p in payloads])
+    assert (got[: len(payloads)] == exp).all()
+
+
+def test_witness_verify_blocks():
+    blocks = [_trie_with_proofs(seed=s) for s in range(4)]
+    # CPU oracle agrees these witnesses are complete
+    for root, entries, nodes in blocks:
+        assert verify_witness(root, entries, nodes)
+
+    node_lists = [nodes for _r, _e, nodes in blocks]
+    roots = roots_to_words([r for r, _e, _n in blocks])
+    blob, meta = pack_witness_blob(node_lists, MAX_CHUNKS)
+    ok = np.asarray(
+        witness_verify(
+            jnp.asarray(blob),
+            jnp.asarray(meta),
+            jnp.asarray(roots),
+            max_chunks=MAX_CHUNKS,
+            n_blocks=len(blocks),
+        )
+    )
+    assert ok.all()
+
+    # corrupt one block's root -> only that block fails
+    bad = roots.copy()
+    bad[2] ^= 0xFF
+    ok = np.asarray(
+        witness_verify(
+            jnp.asarray(blob),
+            jnp.asarray(meta),
+            jnp.asarray(bad),
+            max_chunks=MAX_CHUNKS,
+            n_blocks=len(blocks),
+        )
+    )
+    assert list(ok) == [True, True, False, True]
+
+
+def test_pack_witness_blob_layout():
+    rng = np.random.default_rng(1)
+    nl = [
+        [rng.bytes(int(rng.integers(32, 577))) for _ in range(int(rng.integers(1, 9)))]
+        for _ in range(7)
+    ]
+    blob, meta = pack_witness_blob(nl, MAX_CHUNKS)
+    flat = [n for nodes in nl for n in nodes]
+    offsets, lens, block_id = meta
+    for i, n in enumerate(flat):
+        assert blob[offsets[i] : offsets[i] + lens[i]].tobytes() == n
+    exp_bid = [b for b, nodes in enumerate(nl) for _ in nodes]
+    assert list(block_id[: len(flat)]) == exp_bid
+    assert (lens[len(flat) :] == 0).all()
+    # oversized node rejected
+    try:
+        pack_witness_blob([[b"x" * (MAX_CHUNKS * 136)]], MAX_CHUNKS)
+        raise AssertionError("oversized node accepted")
+    except ValueError:
+        pass
