@@ -1,0 +1,112 @@
+"""CLI entry point: `python -m phant_tpu`.
+
+Equivalent surface to the reference's main (reference: src/main.zig:78-150):
+flag parsing (`--engine_api_port/-p`, `--network_id`, `--chainspec`,
+reference: main.zig:78-92), chain-config resolution + fork-table dump
+(main.zig:109-118), empty StateDB + zero parent header (main.zig:120-140),
+Blockchain construction (main.zig:141) and the Engine API HTTP server
+(main.zig:143-149). Adds `--crypto_backend` per the north star.
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import sys
+import time
+
+from phant_tpu.backend import set_crypto_backend
+from phant_tpu.blockchain.chain import Blockchain
+from phant_tpu.blockchain.fork import fork_for
+from phant_tpu.config import ChainConfig, ChainId
+from phant_tpu.engine_api.server import EngineAPIServer
+from phant_tpu.state.statedb import StateDB
+from phant_tpu.types.block import BlockHeader
+from phant_tpu.version import RELEASE, revision
+
+log = logging.getLogger("phant_tpu")
+
+
+def build_parser() -> argparse.ArgumentParser:
+    """(reference: PhantArgs, main.zig:78-92)"""
+    p = argparse.ArgumentParser(
+        prog="phant_tpu", description="TPU-native Ethereum execution client"
+    )
+    p.add_argument(
+        "-p",
+        "--engine_api_port",
+        type=int,
+        default=8551,
+        help="Specify the port to listen to for Engine API messages",
+    )
+    p.add_argument(
+        "--network_id",
+        type=int,
+        default=int(ChainId.Mainnet),
+        help="Specify the chain id of the network",
+    )
+    p.add_argument(
+        "--chainspec", type=str, default=None,
+        help="Specify a custom chainspec JSON file",
+    )
+    p.add_argument(
+        "--crypto_backend",
+        choices=("cpu", "tpu"),
+        default="cpu",
+        help="Backend for the stateless crypto hot loop (keccak/MPT/ecrecover)",
+    )
+    # the Engine API is a localhost-trust interface; bind loopback by default
+    p.add_argument("--host", type=str, default="127.0.0.1", help="Bind address")
+    return p
+
+
+def make_genesis_parent_header() -> BlockHeader:
+    """The zeroed pre-genesis parent the reference starts from
+    (reference: main.zig:122-140)."""
+    return BlockHeader(
+        gas_limit=0x1C9C380,
+        base_fee_per_gas=7,
+        withdrawals_root=b"\x00" * 32,
+    )
+
+
+def main(argv=None) -> int:
+    logging.basicConfig(level=logging.INFO, format="%(levelname)s %(name)s: %(message)s")
+    args = build_parser().parse_args(argv)
+
+    set_crypto_backend(args.crypto_backend)
+
+    # chain config resolution (reference: main.zig:109-114)
+    if args.chainspec is not None:
+        config = ChainConfig.from_chainspec_file(args.chainspec)
+    else:
+        config = ChainConfig.from_chain_id(args.network_id)
+
+    log.info("phant-tpu %s (%s)", RELEASE, revision())
+    log.info("chain: %s (id %d)", config.ChainName, config.chainId)
+    print(config.dump())  # (reference: config.dump(), main.zig:118)
+
+    state = StateDB()
+    fork = fork_for(config, state, 0, int(time.time()))
+    log.info("active fork: %s", type(fork).__name__)
+    chain = Blockchain(
+        chain_id=config.chainId,
+        state=state,
+        parent_header=make_genesis_parent_header(),
+        fork=fork,
+        # stateless serving starts from an untracked state: roots for
+        # arbitrary payloads can't be checked without the parent state
+        verify_state_root=False,
+    )
+
+    server = EngineAPIServer(chain, host=args.host, port=args.engine_api_port)
+    log.info("Engine API listening on %s:%d", args.host, server.port)
+    try:
+        server.serve_forever()
+    except KeyboardInterrupt:
+        server.shutdown()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,25 @@
+"""Crypto-backend selection (`--crypto_backend=cpu|tpu`).
+
+The reference has no such switch (its crypto is always native CPU,
+reference: src/crypto/hasher.zig, src/crypto/ecdsa.zig); this framework's
+north star adds a TPU device path for the stateless hot loop (batched
+keccak / MPT witness verify / ecrecover, see phant_tpu/ops/). The selected
+backend is process-global, mirroring how the reference picks its chain
+config once at startup (reference: src/main.zig:109-118).
+"""
+
+from __future__ import annotations
+
+_CRYPTO_BACKEND = "cpu"
+_VALID = ("cpu", "tpu")
+
+
+def set_crypto_backend(name: str) -> None:
+    global _CRYPTO_BACKEND
+    if name not in _VALID:
+        raise ValueError(f"crypto backend must be one of {_VALID}, got {name!r}")
+    _CRYPTO_BACKEND = name
+
+
+def crypto_backend() -> str:
+    return _CRYPTO_BACKEND

@@ -42,6 +42,17 @@ class FrontierFork(Fork):
         return self._hashes.get(number, b"\x00" * 32)
 
 
+def fork_for(config, state: StateDB, block_number: int, timestamp: int) -> "Fork":
+    """Pick the fork implementation from the chain config's activation
+    schedule — the wiring the reference leaves as a TODO (reference:
+    src/engine_api/engine_api.zig:125 "pick the fork based on chain
+    config + block number + timestamp")."""
+    name = config.fork_at(block_number, timestamp)
+    if name in ("prague", "osaka"):
+        return PragueFork(state)
+    return FrontierFork()
+
+
 class PragueFork(Fork):
     """EIP-2935: ancestor hashes in the history system contract
     (reference: prague.zig:26-52; deployContract prague.zig:54-57)."""

@@ -1,0 +1,172 @@
+"""Chain configuration: network ids + geth-style fork-activation schedules.
+
+Equivalent surface to the reference config layer (reference:
+src/config/config.zig:8-94): a `ChainId` enum, a `ChainConfig` parsed from a
+chainspec JSON (embedded mainnet/sepolia specs under `chainspecs/`, matching
+reference src/chainspecs/*.json), and a pretty-table `dump()`
+(reference: config.zig:67-90). Adds `fork_at()` — the fork-resolution logic
+the reference leaves implicit (its EVM revision is hardcoded Shanghai with a
+TODO, reference: src/blockchain/vm.zig:472).
+"""
+
+from __future__ import annotations
+
+import enum
+import json
+from dataclasses import dataclass, fields as dc_fields
+from importlib import resources
+from pathlib import Path
+from typing import Optional
+
+
+class ChainId(enum.IntEnum):
+    """(reference: config.zig:8-16)"""
+
+    SpecTest = 0
+    Mainnet = 1
+    Goerli = 5
+    Testing = 1337
+    Holesky = 17000
+    Kaustinen = 69420
+    Sepolia = 11155111
+
+
+class UnsupportedNetwork(Exception):
+    pass
+
+
+class DeprecatedNetwork(Exception):
+    pass
+
+
+# Fork names ordered oldest -> newest; block-number-activated then
+# timestamp-activated (post-merge) eras.
+BLOCK_FORKS = (
+    ("homestead", "homesteadBlock"),
+    ("dao", "daoForkBlock"),
+    ("tangerine", "eip150Block"),
+    ("spurious_dragon", "eip155Block"),
+    ("byzantium", "byzantiumBlock"),
+    ("constantinople", "constantinopleBlock"),
+    ("petersburg", "petersburgBlock"),
+    ("istanbul", "istanbulBlock"),
+    ("muir_glacier", "muirGlacierBlock"),
+    ("berlin", "berlinBlock"),
+    ("london", "londonBlock"),
+    ("arrow_glacier", "arrowGlacierBlock"),
+    ("gray_glacier", "grayGlacierBlock"),
+)
+TIME_FORKS = (
+    ("shanghai", "shanghaiTime"),
+    ("cancun", "cancunTime"),
+    ("prague", "pragueTime"),
+    ("osaka", "osakaTime"),
+)
+
+
+@dataclass
+class ChainConfig:
+    """Geth-style chainspec (reference: config.zig:18-61). Unknown JSON keys
+    are ignored, exactly like the reference's ignore_unknown_fields parse."""
+
+    ChainName: str = "mainnet"
+    chainId: int = int(ChainId.Mainnet)
+    homesteadBlock: Optional[int] = None
+    daoForkBlock: Optional[int] = None
+    eip150Block: Optional[int] = None
+    eip155Block: Optional[int] = None
+    byzantiumBlock: Optional[int] = None
+    constantinopleBlock: Optional[int] = None
+    petersburgBlock: Optional[int] = None
+    istanbulBlock: Optional[int] = None
+    muirGlacierBlock: Optional[int] = None
+    berlinBlock: Optional[int] = None
+    londonBlock: Optional[int] = None
+    arrowGlacierBlock: Optional[int] = None
+    grayGlacierBlock: Optional[int] = None
+    terminalTotalDifficulty: Optional[int] = None
+    terminalTotalDifficultyPassed: Optional[bool] = None
+    shanghaiTime: Optional[int] = None
+    cancunTime: Optional[int] = None
+    pragueTime: Optional[int] = None
+    osakaTime: Optional[int] = None
+
+    # ------------------------------------------------------------------
+
+    @classmethod
+    def from_chainspec(cls, chainspec: str | bytes) -> "ChainConfig":
+        """(reference: config.zig:53-61)"""
+        raw = json.loads(chainspec)
+        known = {f.name for f in dc_fields(cls)}
+        return cls(**{k: v for k, v in raw.items() if k in known})
+
+    @classmethod
+    def from_chainspec_file(cls, path: str | Path) -> "ChainConfig":
+        return cls.from_chainspec(Path(path).read_text())
+
+    @classmethod
+    def from_chain_id(cls, chain_id: int | ChainId) -> "ChainConfig":
+        """(reference: config.zig:43-51)"""
+        chain_id = ChainId(chain_id)
+        if chain_id == ChainId.Mainnet:
+            return cls.from_chainspec(_embedded_spec("mainnet.json"))
+        if chain_id == ChainId.Sepolia:
+            return cls.from_chainspec(_embedded_spec("sepolia.json"))
+        if chain_id == ChainId.Goerli:
+            raise DeprecatedNetwork("goerli is deprecated")
+        raise UnsupportedNetwork(f"no embedded chainspec for {chain_id!r}")
+
+    @classmethod
+    def default(cls) -> "ChainConfig":
+        return cls.from_chain_id(ChainId.Mainnet)
+
+    # ------------------------------------------------------------------
+
+    def fork_at(self, block_number: int, timestamp: int) -> str:
+        """Newest active fork name at (block_number, timestamp). Beyond the
+        reference: it hardcodes EVMC_SHANGHAI (vm.zig:472)."""
+        active = "frontier"
+        for name, attr in BLOCK_FORKS:
+            activation = getattr(self, attr)
+            if activation is not None and block_number >= activation:
+                active = name
+        for name, attr in TIME_FORKS:
+            activation = getattr(self, attr)
+            if activation is not None and timestamp >= activation:
+                active = name
+        return active
+
+    def is_shanghai(self, timestamp: int) -> bool:
+        return self.shanghaiTime is not None and timestamp >= self.shanghaiTime
+
+    # ------------------------------------------------------------------
+
+    def dump(self) -> str:
+        """Box-drawing fork table (reference: config.zig:67-90)."""
+        rows = [("Fork", "Block number", "Timestamp")]
+        for name, attr in BLOCK_FORKS:
+            v = getattr(self, attr)
+            rows.append((name, str(v) if v is not None else "inactive", "na"))
+        for name, attr in TIME_FORKS:
+            v = getattr(self, attr)
+            rows.append((name, "na", str(v) if v is not None else "inactive"))
+        widths = [max(len(r[i]) for r in rows) for i in range(3)]
+
+        def line(l, m, r):
+            return l + m.join("─" * (w + 2) for w in widths) + r
+
+        out = [line("┌", "┬", "┐")]
+        for i, row in enumerate(rows):
+            out.append(
+                "│" + "│".join(f" {c.ljust(w)} " for c, w in zip(row, widths)) + "│"
+            )
+            if i == 0:
+                out.append(line("├", "┼", "┤"))
+        out.append(line("└", "┴", "┘"))
+        return "\n".join(out)
+
+
+def _embedded_spec(name: str) -> str:
+    return (
+        resources.files("phant_tpu.config").joinpath("chainspecs", name).read_text()
+    )

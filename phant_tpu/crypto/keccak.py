@@ -114,7 +114,21 @@ def keccak256_with_prefix(prefix: int, data: bytes) -> bytes:
 
 
 def keccak256_batch(payloads: Sequence[bytes]) -> List[bytes]:
-    """Hash many payloads on the CPU backend (native loop if available)."""
+    """Hash many payloads on the selected backend: the TPU kernel when
+    `--crypto_backend=tpu` (phant_tpu/ops/keccak_jax.py), else the CPU
+    fast path (native loop if available)."""
+    from phant_tpu.backend import crypto_backend
+
+    if crypto_backend() == "tpu":
+        from phant_tpu.ops.keccak_jax import keccak256_batch_jax
+
+        return keccak256_batch_jax(payloads)
+    return keccak256_batch_cpu(payloads)
+
+
+def keccak256_batch_cpu(payloads: Sequence[bytes]) -> List[bytes]:
+    """Always the CPU path (native loop if available) — the baseline side
+    of CPU-vs-TPU differential tests."""
     if _native is not None:
         return _native.keccak256_batch(payloads)
     return [_keccak256_python(p) for p in payloads]

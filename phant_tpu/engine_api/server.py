@@ -1,0 +1,84 @@
+"""Threaded HTTP JSON-RPC server for the Engine API.
+
+Equivalent surface to the reference's httpz server wiring (reference:
+src/main.zig:143-149: POST / routed to engineAPIHandler with the
+*Blockchain as per-request context). Uses the stdlib ThreadingHTTPServer —
+the handler holds a lock around block execution because `Blockchain`
+mutates shared state (the reference is effectively serial there too).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from phant_tpu.engine_api import handle_request
+
+log = logging.getLogger("phant_tpu.engine_api")
+
+
+class EngineAPIServer:
+    """HTTP server bound to a Blockchain (reference: main.zig:143-149)."""
+
+    def __init__(self, blockchain, host: str = "127.0.0.1", port: int = 8551):
+        self.blockchain = blockchain
+        self._lock = threading.Lock()
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def do_POST(self) -> None:  # noqa: N802 (stdlib API)
+                length = int(self.headers.get("Content-Length", 0))
+                body = self.rfile.read(length)
+                try:
+                    request = json.loads(body)
+                except json.JSONDecodeError:
+                    self._reply(400, {"error": {"code": -32700, "message": "parse error"}})
+                    return
+                if not isinstance(request, dict):
+                    # batch requests and non-object bodies are not supported
+                    self._reply(
+                        400,
+                        {
+                            "jsonrpc": "2.0",
+                            "id": None,
+                            "error": {"code": -32600, "message": "invalid request"},
+                        },
+                    )
+                    return
+                with outer._lock:
+                    status, response = handle_request(outer.blockchain, request)
+                self._reply(status, response)
+
+            def _reply(self, status: int, payload: dict) -> None:
+                raw = json.dumps(payload).encode()
+                self.send_response(status)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(raw)))
+                self.end_headers()
+                self.wfile.write(raw)
+
+            def log_message(self, fmt, *args):  # route to logging, not stderr
+                log.debug(fmt, *args)
+
+        self._server = ThreadingHTTPServer((host, port), Handler)
+
+    @property
+    def port(self) -> int:
+        return self._server.server_address[1]
+
+    def serve_forever(self) -> None:
+        log.info("Engine API listening on :%d", self.port)
+        self._server.serve_forever()
+
+    def serve_in_background(self) -> threading.Thread:
+        t = threading.Thread(target=self._server.serve_forever, daemon=True)
+        t.start()
+        return t
+
+    def shutdown(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()

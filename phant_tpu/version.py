@@ -1,0 +1,33 @@
+"""Release version + git revision.
+
+Equivalent to the reference's generated version module (reference:
+build.zig:40-58 writes src/version.zig from build.zig.zon + `git rev-parse`,
+gitRevision at build.zig:23). Here the revision is resolved lazily at
+runtime instead of at build time.
+"""
+
+from __future__ import annotations
+
+import functools
+import subprocess
+from pathlib import Path
+
+RELEASE = "0.0.1-beta-0"
+
+
+@functools.lru_cache(maxsize=1)
+def revision() -> str:
+    """Short git revision of the working tree, or "unknown" outside git."""
+    try:
+        out = subprocess.run(
+            ["git", "rev-parse", "--short", "HEAD"],
+            cwd=Path(__file__).parent,
+            capture_output=True,
+            text=True,
+            timeout=5,
+        )
+        if out.returncode == 0:
+            return out.stdout.strip()
+    except Exception:
+        pass
+    return "unknown"

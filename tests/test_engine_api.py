@@ -1,0 +1,298 @@
+"""Engine API + config + CLI tests.
+
+Mirrors the reference's engine-API round-trip test (reference:
+src/engine_api/engine_api.zig:87-134): build a real `engine_newPayloadV2`
+JSON-RPC request, decode it through the hex intermediate, and drive it
+through the handler against a fresh Blockchain — plus an actual HTTP
+round-trip (reference serves via httpz, main.zig:143-149) and chain-config
+parity checks (reference: src/config/config.zig).
+"""
+
+from __future__ import annotations
+
+import json
+import urllib.request
+
+import pytest
+
+from phant_tpu.blockchain.chain import Blockchain
+from phant_tpu.config import (
+    ChainConfig,
+    ChainId,
+    DeprecatedNetwork,
+    UnsupportedNetwork,
+)
+from phant_tpu.engine_api import (
+    ExecutionPayload,
+    get_client_version_v1_handler,
+    handle_request,
+    new_payload_v2_handler,
+    payload_from_json,
+)
+from phant_tpu.engine_api.server import EngineAPIServer
+from phant_tpu.mpt.mpt import ordered_trie_root
+from phant_tpu.state.statedb import StateDB
+from phant_tpu.types.block import BlockHeader
+from phant_tpu.types.receipt import logs_bloom
+from phant_tpu.utils.hexutils import bytes_to_hex
+from phant_tpu.__main__ import build_parser, make_genesis_parent_header
+
+
+# ---------------------------------------------------------------------------
+# config
+
+
+def test_mainnet_chainspec():
+    cfg = ChainConfig.from_chain_id(ChainId.Mainnet)
+    assert cfg.ChainName == "mainnet"
+    assert cfg.chainId == 1
+    assert cfg.londonBlock == 12965000
+    assert cfg.shanghaiTime == 1681338455
+    assert cfg.terminalTotalDifficultyPassed is True
+
+
+def test_sepolia_and_errors():
+    cfg = ChainConfig.from_chain_id(ChainId.Sepolia)
+    assert cfg.chainId == int(ChainId.Sepolia)
+    assert cfg.londonBlock == 0
+    with pytest.raises(DeprecatedNetwork):
+        ChainConfig.from_chain_id(ChainId.Goerli)
+    with pytest.raises(UnsupportedNetwork):
+        ChainConfig.from_chain_id(ChainId.Holesky)
+
+
+def test_fork_at():
+    cfg = ChainConfig.from_chain_id(ChainId.Mainnet)
+    assert cfg.fork_at(0, 0) == "frontier"
+    assert cfg.fork_at(1_150_000, 0) == "homestead"
+    assert cfg.fork_at(15_537_394, 1663224162) == "gray_glacier"
+    assert cfg.fork_at(17_034_870, 1681338455) == "shanghai"
+    assert cfg.is_shanghai(1681338455)
+    assert not cfg.is_shanghai(1681338454)
+
+
+def test_config_dump_and_unknown_fields():
+    cfg = ChainConfig.from_chainspec(
+        json.dumps({"ChainName": "t", "chainId": 7, "londonBlock": 5, "bogus": 1})
+    )
+    assert cfg.chainId == 7 and cfg.londonBlock == 5
+    table = ChainConfig.from_chain_id(ChainId.Mainnet).dump()
+    assert "london" in table and "12965000" in table and "shanghai" in table
+
+
+def test_cli_parser_defaults():
+    args = build_parser().parse_args([])
+    assert args.engine_api_port == 8551
+    assert args.network_id == 1
+    assert args.crypto_backend == "cpu"
+    args = build_parser().parse_args(["-p", "9999", "--crypto_backend", "tpu"])
+    assert args.engine_api_port == 9999 and args.crypto_backend == "tpu"
+
+
+# ---------------------------------------------------------------------------
+# engine API
+
+
+def _fresh_chain() -> Blockchain:
+    """Blockchain over the reference's zero parent (main.zig:120-141)."""
+    return Blockchain(
+        chain_id=int(ChainId.Testing),
+        state=StateDB(),
+        parent_header=make_genesis_parent_header(),
+        verify_state_root=False,
+    )
+
+
+def _valid_payload_json() -> dict:
+    """A consensus-valid empty-tx payload with one withdrawal on top of the
+    zero parent, in Engine API JSON form."""
+    parent = make_genesis_parent_header()
+    wd = {
+        "index": "0x0",
+        "validatorIndex": "0x7",
+        "address": "0x" + "aa" * 20,
+        "amount": "0x3b9aca00",  # 1 ETH in gwei
+    }
+    return {
+        "parentHash": bytes_to_hex(parent.hash()),
+        "feeRecipient": "0x" + "bb" * 20,
+        "stateRoot": "0x" + "00" * 32,
+        "receiptsRoot": bytes_to_hex(ordered_trie_root([])),
+        "logsBloom": bytes_to_hex(logs_bloom([])),
+        "prevRandao": "0x" + "00" * 32,
+        "blockNumber": "0x1",
+        "gasLimit": hex(parent.gas_limit),
+        "gasUsed": "0x0",
+        "timestamp": "0x1",
+        "extraData": "0x",
+        "baseFeePerGas": "0x7",
+        "blockHash": "0x" + "cc" * 32,  # patched to the real hash below
+        "transactions": [],
+        "withdrawals": [wd],
+    }
+
+
+def _with_real_block_hash(params: dict) -> dict:
+    """Fill blockHash = keccak(rlp(header)) as a real CL client would."""
+    computed = payload_from_json(params).to_block().header.hash()
+    return {**params, "blockHash": bytes_to_hex(computed)}
+
+
+def test_payload_from_json_roundtrip():
+    payload = payload_from_json(_valid_payload_json())
+    assert isinstance(payload, ExecutionPayload)
+    assert payload.block_number == 1
+    assert payload.base_fee_per_gas == 7
+    assert payload.withdrawals is not None and len(payload.withdrawals) == 1
+    assert payload.withdrawals[0].amount == 0x3B9ACA00
+    block = payload.to_block()
+    assert block.header.transactions_root == ordered_trie_root([])
+    assert block.header.withdrawals_root == ordered_trie_root(
+        [payload.withdrawals[0].encode()]
+    )
+
+
+def test_new_payload_v2_valid_applies_withdrawal():
+    chain = _fresh_chain()
+    payload = payload_from_json(_with_real_block_hash(_valid_payload_json()))
+    status = new_payload_v2_handler(chain, payload)
+    assert status.status == "VALID", status.validation_error
+    assert status.latest_valid_hash == payload.block_hash
+    acct = chain.state.get_account(b"\xaa" * 20)
+    assert acct is not None and acct.balance == 10**18
+
+
+def test_new_payload_v2_rejects_wrong_block_hash():
+    """Engine API spec: blockHash must equal keccak(rlp(header))."""
+    chain = _fresh_chain()
+    payload = payload_from_json(_valid_payload_json())  # bogus 0xcc..cc hash
+    status = new_payload_v2_handler(chain, payload)
+    assert status.status == "INVALID"
+    assert "blockHash" in (status.validation_error or "")
+    # and nothing was executed
+    assert chain.state.get_account(b"\xaa" * 20) is None
+    assert chain.parent_header.block_number == 0
+
+
+def test_new_payload_v2_invalid_base_fee():
+    chain = _fresh_chain()
+    bad = _valid_payload_json()
+    bad["baseFeePerGas"] = "0x8"
+    status = new_payload_v2_handler(chain, payload_from_json(_with_real_block_hash(bad)))
+    assert status.status == "INVALID"
+    assert "base fee" in (status.validation_error or "")
+
+
+def test_new_payload_v2_invalid_rolls_back_state():
+    """An INVALID payload leaves no trace: the withdrawal credited during
+    apply_body must be rolled back when a post-execution check fails."""
+    chain = _fresh_chain()
+    bad = _valid_payload_json()
+    bad["gasUsed"] = "0x5208"  # header claims gas that was never consumed
+    status = new_payload_v2_handler(chain, payload_from_json(_with_real_block_hash(bad)))
+    assert status.status == "INVALID"
+    assert chain.state.get_account(b"\xaa" * 20) is None
+    assert chain.parent_header.block_number == 0
+    # the same payload, corrected, then applies exactly once
+    good = payload_from_json(_with_real_block_hash(_valid_payload_json()))
+    assert new_payload_v2_handler(chain, good).status == "VALID"
+    assert chain.state.get_account(b"\xaa" * 20).balance == 10**18
+
+
+def test_fork_for_config():
+    from phant_tpu.blockchain.fork import FrontierFork, PragueFork, fork_for
+
+    cfg = ChainConfig.from_chain_id(ChainId.Mainnet)
+    state = StateDB()
+    assert isinstance(fork_for(cfg, state, 0, 0), FrontierFork)
+    assert isinstance(fork_for(cfg, state, 0, cfg.shanghaiTime), FrontierFork)
+    assert isinstance(fork_for(cfg, state, 0, cfg.pragueTime), PragueFork)
+
+
+def test_crypto_backend_dispatch():
+    """--crypto_backend=tpu routes keccak256_batch to the JAX kernel and
+    agrees bit-for-bit with the CPU path."""
+    from phant_tpu.backend import crypto_backend, set_crypto_backend
+    from phant_tpu.crypto.keccak import keccak256_batch, keccak256_batch_cpu
+
+    payloads = [bytes([i]) * (i + 1) for i in range(8)]
+    cpu = keccak256_batch_cpu(payloads)
+    assert keccak256_batch(payloads) == cpu  # default backend is cpu
+    set_crypto_backend("tpu")
+    try:
+        assert crypto_backend() == "tpu"
+        assert keccak256_batch(payloads) == cpu
+    finally:
+        set_crypto_backend("cpu")
+    with pytest.raises(ValueError):
+        set_crypto_backend("gpu")
+
+
+def test_handle_request_dispatch():
+    chain = _fresh_chain()
+    req = {
+        "jsonrpc": "2.0",
+        "id": 1,
+        "method": "engine_newPayloadV2",
+        "params": [_with_real_block_hash(_valid_payload_json())],
+    }
+    code, resp = handle_request(chain, req)
+    assert code == 200 and resp["result"]["status"] == "VALID"
+
+    # known-but-unimplemented -> HTTP 500 (reference: main.zig:72)
+    code, resp = handle_request(chain, {"id": 2, "method": "engine_getPayloadV2"})
+    assert code == 500 and "error" in resp
+    # unknown method -> JSON-RPC method-not-found
+    code, resp = handle_request(chain, {"id": 3, "method": "eth_bogus"})
+    assert code == 200 and resp["error"]["code"] == -32601
+
+
+def test_client_version():
+    ver = get_client_version_v1_handler()
+    assert ver.code == "PH"
+    assert ver.version.startswith("0.0.1")
+    assert ver.string().startswith("PH-")
+    chain = _fresh_chain()
+    code, resp = handle_request(
+        chain, {"id": 9, "method": "engine_getClientVersionV1", "params": []}
+    )
+    assert code == 200 and resp["result"][0]["code"] == "PH"
+
+
+def test_http_server_roundtrip():
+    """Full HTTP POST round-trip (reference: main.zig:143-149 via httpz)."""
+    chain = _fresh_chain()
+    server = EngineAPIServer(chain, host="127.0.0.1", port=0)
+    server.serve_in_background()
+    try:
+        body = json.dumps(
+            {
+                "jsonrpc": "2.0",
+                "id": 1,
+                "method": "engine_newPayloadV2",
+                "params": [_with_real_block_hash(_valid_payload_json())],
+            }
+        ).encode()
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{server.port}/",
+            data=body,
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            out = json.loads(resp.read())
+        assert out["result"]["status"] == "VALID"
+        assert chain.parent_header.block_number == 1
+
+        # JSON-RPC batch (array body) -> -32600, connection stays healthy
+        batch = json.dumps([{"id": 2, "method": "engine_getClientVersionV1"}]).encode()
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{server.port}/",
+            data=batch,
+            headers={"Content-Type": "application/json"},
+        )
+        with pytest.raises(urllib.error.HTTPError) as exc_info:
+            urllib.request.urlopen(req, timeout=10)
+        assert exc_info.value.code == 400
+        assert json.loads(exc_info.value.read())["error"]["code"] == -32600
+    finally:
+        server.shutdown()
