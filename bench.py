@@ -127,6 +127,12 @@ def main() -> None:
     assert ok_dev == n_blocks, f"device verified {ok_dev}/{n_blocks}"
 
     dev_rate = n_blocks / dev_s
+    detail = {
+        "backend": jax.devices()[0].platform,
+        "cpu_baseline_blocks_per_sec": round(cpu_rate, 2),
+        "nodes_per_block": round(sum(len(n) for n in node_lists) / n_blocks, 1),
+    }
+    detail.update(bench_ecrecover())
     print(
         json.dumps(
             {
@@ -134,16 +140,55 @@ def main() -> None:
                 "value": round(dev_rate, 2),
                 "unit": "blocks/s",
                 "vs_baseline": round(dev_rate / cpu_rate, 2),
-                "detail": {
-                    "backend": jax.devices()[0].platform,
-                    "cpu_baseline_blocks_per_sec": round(cpu_rate, 2),
-                    "nodes_per_block": round(
-                        sum(len(n) for n in node_lists) / n_blocks, 1
-                    ),
-                },
+                "detail": detail,
             }
         )
     )
+
+
+def bench_ecrecover() -> dict:
+    """BASELINE.md config #4: batched sender recovery for a block's tx list.
+    Device = the fused secp256k1+keccak kernel; CPU baseline = the scalar
+    backend (reference scope: src/crypto/ecdsa.zig:19-26 per tx)."""
+    import os
+
+    if os.environ.get("PHANT_BENCH_ECRECOVER", "1") in ("0", ""):
+        return {}
+    try:
+        from phant_tpu.crypto.keccak import keccak256
+        from phant_tpu.crypto import secp256k1 as cpu_secp
+        from phant_tpu.ops.secp256k1_jax import ecrecover_batch
+
+        rng = np.random.default_rng(3)
+        B = 128  # one mainnet-block-sized tx list
+        keys = [int.from_bytes(rng.bytes(32), "big") % cpu_secp.N or 1 for _ in range(B)]
+        msgs = [keccak256(rng.bytes(64)) for _ in range(B)]
+        sigs = [cpu_secp.sign(m, k) for m, k in zip(msgs, keys)]
+        rs = [s[0] for s in sigs]
+        ss = [s[1] for s in sigs]
+        recids = [s[2] for s in sigs]
+
+        # CPU baseline on a sample (pure-Python scalar path is slow)
+        t0 = time.perf_counter()
+        sample = 8
+        for i in range(sample):
+            cpu_secp.recover_pubkey(msgs[i], rs[i], ss[i], recids[i])
+        cpu_rate = sample / (time.perf_counter() - t0)
+
+        out = ecrecover_batch(msgs, rs, ss, recids)  # compile + correctness
+        expected = [keccak256(cpu_secp.pubkey_of(k)[1:])[12:] for k in keys]
+        assert out == expected, "device ecrecover mismatch vs CPU"
+        reps = 5
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            ecrecover_batch(msgs, rs, ss, recids)
+        dev_rate = B * reps / (time.perf_counter() - t0)
+        return {
+            "ecrecover_per_sec": round(dev_rate, 1),
+            "ecrecover_cpu_baseline_per_sec": round(cpu_rate, 1),
+        }
+    except Exception as e:  # never let the secondary metric sink the bench
+        return {"ecrecover_error": repr(e)[:200]}
 
 
 if __name__ == "__main__":

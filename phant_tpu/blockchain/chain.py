@@ -76,12 +76,26 @@ class Blockchain:
 
     # ------------------------------------------------------------------
 
-    def run_block(self, block: Block) -> BlockExecutionResult:
-        """Validate + execute + verify roots (reference: blockchain.zig:61-96)."""
+    def run_block(self, block: Block, check_body_roots: bool = True) -> BlockExecutionResult:
+        """Validate + execute + verify roots (reference: blockchain.zig:61-96).
+
+        An invalid block leaves no trace: execution is journaled and rolled
+        back on any failure. `check_body_roots=False` skips re-deriving the
+        tx/withdrawal roots — used by the Engine API path, whose `to_block`
+        derived exactly those roots from the same tx/withdrawal tuples one
+        call earlier (the blockHash check covers header integrity there)."""
         self.validate_block_header(block.header)
         if block.uncles:
             raise BlockError("post-merge blocks must have no uncles")
 
+        self.state.begin_block()
+        try:
+            return self._execute_block(block, check_body_roots)
+        except BaseException:
+            self.state.rollback_block()
+            raise
+
+    def _execute_block(self, block: Block, check_body_roots: bool) -> BlockExecutionResult:
         # record parent hash for BLOCKHASH (reference: blockchain.zig:71)
         self.fork.update_parent_block_hash(
             self.parent_header.block_number, self.parent_header.hash()
@@ -94,16 +108,17 @@ class Blockchain:
             raise BlockError(
                 f"gas_used mismatch: computed {result.gas_used}, header {header.gas_used}"
             )
-        tx_root = ordered_trie_root([tx.encode() for tx in block.transactions])
-        if tx_root != header.transactions_root:
-            raise BlockError("transactions root mismatch")
+        if check_body_roots:
+            tx_root = ordered_trie_root([tx.encode() for tx in block.transactions])
+            if tx_root != header.transactions_root:
+                raise BlockError("transactions root mismatch")
+            if block.withdrawals is not None:
+                wd_root = ordered_trie_root([w.encode() for w in block.withdrawals])
+                if wd_root != header.withdrawals_root:
+                    raise BlockError("withdrawals root mismatch")
         receipts_root = ordered_trie_root([r.encode() for r in result.receipts])
         if receipts_root != header.receipts_root:
             raise BlockError("receipts root mismatch")
-        if block.withdrawals is not None:
-            wd_root = ordered_trie_root([w.encode() for w in block.withdrawals])
-            if wd_root != header.withdrawals_root:
-                raise BlockError("withdrawals root mismatch")
         if result.logs_bloom != header.logs_bloom:
             raise BlockError("logs bloom mismatch")
         if self.verify_state_root:
@@ -163,8 +178,16 @@ class Blockchain:
         cumulative_gas = 0
         all_logs = []
 
-        for tx in block.transactions:
-            sender = self.check_transaction(tx, header, gas_available)
+        # recover every sender up front — one fused device call on the tpu
+        # crypto backend, serial CPU otherwise (reference recovers per-tx,
+        # blockchain.zig:241)
+        try:
+            senders = self.signer.get_senders_batch(list(block.transactions))
+        except SignatureError as e:
+            raise BlockError(f"invalid signature: {e}") from e
+
+        for tx, sender in zip(block.transactions, senders):
+            self.check_transaction(tx, header, gas_available, sender)
             gas_used, tx_logs, succeeded = self.process_transaction(tx, sender, header)
             gas_available -= gas_used
             cumulative_gas += gas_used
@@ -184,7 +207,7 @@ class Blockchain:
                 self.state.add_balance(wd.address, wd.amount * GWEI)
                 acct = self.state.get_account(wd.address)
                 if acct is not None and acct.is_empty():
-                    self.state.accounts.pop(wd.address, None)
+                    self.state.delete_account(wd.address)
 
         return BlockExecutionResult(
             gas_used=cumulative_gas,
@@ -194,8 +217,11 @@ class Blockchain:
 
     # ------------------------------------------------------------------
 
-    def check_transaction(self, tx: Transaction, header: BlockHeader, gas_available: int) -> bytes:
-        """(reference: blockchain.zig:237-260 + validateTransaction :345-353)"""
+    def check_transaction(
+        self, tx: Transaction, header: BlockHeader, gas_available: int, sender: bytes
+    ) -> bytes:
+        """(reference: blockchain.zig:237-260 + validateTransaction :345-353;
+        sender recovery itself happens batched in apply_body)"""
         if tx.gas_limit > gas_available:
             raise BlockError("tx gas limit exceeds available block gas")
         base_fee = header.base_fee_per_gas or 0
@@ -207,10 +233,6 @@ class Blockchain:
         else:
             if tx.gas_price < base_fee:
                 raise BlockError("gas price below base fee")
-        try:
-            sender = self.signer.get_sender(tx)
-        except SignatureError as e:
-            raise BlockError(f"invalid signature: {e}") from e
 
         # intrinsic validity (reference: validateTransaction blockchain.zig:345-353)
         is_create = tx.to is None
@@ -309,7 +331,7 @@ class Blockchain:
 
         # selfdestructs delete accounts wholesale
         for addr in state.selfdestructs:
-            state.accounts.pop(addr, None)
+            state.delete_account(addr)
 
         # EIP-158 (reference: blockchain.zig:334-341 via statedb)
         state.destroy_touched_empty()

@@ -63,14 +63,18 @@ class PragueFork(Fork):
 
     def deploy_contract(self) -> None:
         if not self.state.get_code(HISTORY_STORAGE_ADDRESS):
-            acct = self.state.create_account(HISTORY_STORAGE_ADDRESS)
-            acct.nonce = 1
-            acct.code = b"\x00"  # placeholder body; spec contract is immaterial here
+            self.state.create_account(HISTORY_STORAGE_ADDRESS)
+            self.state.set_nonce(HISTORY_STORAGE_ADDRESS, 1)
+            # placeholder body; spec contract bytecode is immaterial here
+            self.state.set_code(HISTORY_STORAGE_ADDRESS, b"\x00")
 
     def update_parent_block_hash(self, number: int, block_hash: bytes) -> None:
         slot = number % HISTORY_SERVE_WINDOW
-        acct = self.state.create_account(HISTORY_STORAGE_ADDRESS)
-        acct.storage[slot] = int.from_bytes(block_hash, "big")
+        self.state.create_account(HISTORY_STORAGE_ADDRESS)
+        # journaled write so block-level rollback undoes it
+        self.state.set_storage(
+            HISTORY_STORAGE_ADDRESS, slot, int.from_bytes(block_hash, "big")
+        )
 
     def get_block_hash(self, number: int) -> bytes:
         value = self.state.get_storage(HISTORY_STORAGE_ADDRESS, number % HISTORY_SERVE_WINDOW)

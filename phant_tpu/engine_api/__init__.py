@@ -308,13 +308,12 @@ def new_payload_v2_handler(blockchain, payload: ExecutionPayload) -> PayloadStat
                 f"computed {computed_hash.hex()}"
             ),
         )
-    backup = blockchain.state.copy()
-    parent_backup = blockchain.parent_header
     try:
-        blockchain.run_block(block)
+        # run_block journals + rolls back internally on failure; the tx /
+        # withdrawal roots were derived by to_block one call earlier, so
+        # skip re-deriving them
+        blockchain.run_block(block, check_body_roots=False)
     except BlockError as e:
-        blockchain.state.accounts = backup.accounts
-        blockchain.parent_header = parent_backup
         return PayloadStatusV1(status="INVALID", validation_error=str(e))
     return PayloadStatusV1(status="VALID", latest_valid_hash=computed_hash)
 
@@ -383,6 +382,6 @@ def handle_request(blockchain, request: dict) -> Tuple[int, dict]:
     if method in SUPPORTED_METHODS:
         return 500, {
             **base,
-            "error": {"code": -38004, "message": f"{method} not implemented"},
+            "error": {"code": -32601, "message": f"{method} not implemented"},
         }
     return 200, {**base, "error": {"code": -32601, "message": "method not found"}}

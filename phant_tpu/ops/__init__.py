@@ -1,0 +1,9 @@
+"""Device (TPU) kernels for the stateless-validation hot loop.
+
+Importing this package enables the persistent XLA compilation cache so the
+expensive kernels (ecrecover ladder, keccak) compile once per machine.
+"""
+
+from phant_tpu.ops._cache import enable_compilation_cache
+
+enable_compilation_cache()

@@ -1,0 +1,32 @@
+"""Persistent XLA compilation cache for the device kernels.
+
+The heavy kernels (the 256-step ecrecover ladder in particular) take
+minutes to compile but milliseconds to run; caching compiled programs
+under build/jax_cache makes every process after the first start instantly.
+Opt out with PHANT_NO_JAX_CACHE=1.
+"""
+
+from __future__ import annotations
+
+import os
+from pathlib import Path
+
+_configured = False
+
+
+def enable_compilation_cache() -> None:
+    global _configured
+    if _configured or os.environ.get("PHANT_NO_JAX_CACHE"):
+        return
+    _configured = True
+    try:
+        import jax
+
+        default = Path(__file__).resolve().parents[2] / "build" / "jax_cache"
+        cache_dir = os.environ.get("PHANT_JAX_CACHE", str(default))
+        Path(cache_dir).mkdir(parents=True, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 2.0)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+    except Exception:
+        pass  # older jax or read-only fs: kernels still work, just uncached

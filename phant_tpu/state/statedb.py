@@ -39,10 +39,23 @@ class StateDB:
     # tx lifecycle
     # ------------------------------------------------------------------
 
+    def begin_block(self) -> None:
+        """Start a block-scoped undo log: every mutation until commit/rollback
+        is journaled, so an invalid block rolls back in O(mutations) instead
+        of the O(world-state) deep clone the reference uses per snapshot
+        (reference: src/state/statedb.zig:171-182)."""
+        self._journal.clear()
+
+    def rollback_block(self) -> None:
+        """Undo every mutation since begin_block (invalid blocks must leave
+        no trace)."""
+        self.revert_to(0)
+
     def start_tx(self) -> None:
         """Reset per-tx scopes (reference: src/state/statedb.zig:62-69 clones
-        the whole db as `original_db`; we record originals lazily instead)."""
-        self._journal.clear()
+        the whole db as `original_db`; we record originals lazily instead).
+        The journal is NOT cleared — it spans the whole block for
+        begin_block/rollback_block."""
         self._tx_original.clear()
         self.accessed_addresses = set()
         self.accessed_storage_keys = set()
@@ -102,7 +115,10 @@ class StateDB:
                 (addr,) = payload
                 self.created.discard(addr)
             elif tag == "log":
-                self.logs.pop()
+                # block-level rollback may replay entries from earlier txs
+                # whose per-tx log list start_tx already reset
+                if self.logs:
+                    self.logs.pop()
             elif tag == "refund":
                 (old,) = payload
                 self.refund = old
@@ -270,7 +286,7 @@ class StateDB:
         for addr in list(self.touched):
             acct = self.accounts.get(addr)
             if acct is not None and acct.is_empty():
-                del self.accounts[addr]
+                self.delete_account(addr)
 
     def state_root(self) -> bytes:
         return _state_root(self.accounts)

@@ -17,11 +17,23 @@ RELEASE = "0.0.1-beta-0"
 
 @functools.lru_cache(maxsize=1)
 def revision() -> str:
-    """Short git revision of the working tree, or "unknown" outside git."""
+    """Short git revision of the framework's own checkout, or "unknown".
+    Guards against reporting the hash of an unrelated repo that happens to
+    enclose an installed copy (e.g. site-packages under a monorepo)."""
+    pkg_dir = Path(__file__).resolve().parent
     try:
+        top = subprocess.run(
+            ["git", "rev-parse", "--show-toplevel"],
+            cwd=pkg_dir,
+            capture_output=True,
+            text=True,
+            timeout=5,
+        )
+        if top.returncode != 0 or not (Path(top.stdout.strip()) / "phant_tpu").is_dir():
+            return "unknown"
         out = subprocess.run(
             ["git", "rev-parse", "--short", "HEAD"],
-            cwd=Path(__file__).parent,
+            cwd=pkg_dir,
             capture_output=True,
             text=True,
             timeout=5,
