@@ -15,7 +15,7 @@ import logging
 import sys
 import time
 
-from phant_tpu.backend import set_crypto_backend
+from phant_tpu.backend import set_crypto_backend, set_evm_backend
 from phant_tpu.blockchain.chain import Blockchain
 from phant_tpu.blockchain.fork import fork_for
 from phant_tpu.config import ChainConfig, ChainId
@@ -55,6 +55,13 @@ def build_parser() -> argparse.ArgumentParser:
         default="cpu",
         help="Backend for the stateless crypto hot loop (keccak/MPT/ecrecover)",
     )
+    p.add_argument(
+        "--evm_backend",
+        choices=("python", "native"),
+        default="native",
+        help="EVM bytecode interpreter: native C++ core (evmone-equivalent) "
+        "or the pure-Python reference interpreter",
+    )
     # the Engine API is a localhost-trust interface; bind loopback by default
     p.add_argument("--host", type=str, default="127.0.0.1", help="Bind address")
     return p
@@ -75,6 +82,7 @@ def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
 
     set_crypto_backend(args.crypto_backend)
+    set_evm_backend(args.evm_backend)
 
     # chain config resolution (reference: main.zig:109-114)
     if args.chainspec is not None:

@@ -13,6 +13,11 @@ from __future__ import annotations
 _CRYPTO_BACKEND = "cpu"
 _VALID = ("cpu", "tpu")
 
+# EVM bytecode execution backend: "python" (phant_tpu/evm/interpreter.py) or
+# "native" (the C++ core in native/evm.cc, the reference's evmone analog).
+_EVM_BACKEND = "python"
+_VALID_EVM = ("python", "native")
+
 
 def set_crypto_backend(name: str) -> None:
     global _CRYPTO_BACKEND
@@ -23,3 +28,14 @@ def set_crypto_backend(name: str) -> None:
 
 def crypto_backend() -> str:
     return _CRYPTO_BACKEND
+
+
+def set_evm_backend(name: str) -> None:
+    global _EVM_BACKEND
+    if name not in _VALID_EVM:
+        raise ValueError(f"evm backend must be one of {_VALID_EVM}, got {name!r}")
+    _EVM_BACKEND = name
+
+
+def evm_backend() -> str:
+    return _EVM_BACKEND

@@ -219,7 +219,7 @@ class Blockchain:
 
     def check_transaction(
         self, tx: Transaction, header: BlockHeader, gas_available: int, sender: bytes
-    ) -> bytes:
+    ) -> None:
         """(reference: blockchain.zig:237-260 + validateTransaction :345-353;
         sender recovery itself happens batched in apply_body)"""
         if tx.gas_limit > gas_available:
@@ -254,7 +254,6 @@ class Blockchain:
         balance = sender_acct.balance if sender_acct else 0
         if balance < max_cost:
             raise BlockError("insufficient sender balance for gas + value")
-        return sender
 
     # ------------------------------------------------------------------
 

@@ -178,11 +178,7 @@ class Evm:
         if not code:
             return ExecResult(True, msg.gas)
 
-        frame = Frame(
-            msg=msg, code=code, gas=msg.gas, address=target,
-            jumpdests=valid_jumpdests(code),
-        )
-        result = self._run(frame)
+        result = self._execute_code(code, msg, target)
         if not result.success:
             state.revert_to(snapshot)
         return result
@@ -211,16 +207,12 @@ class Evm:
             state.sub_balance(msg.caller, msg.value)
             state.add_balance(addr, msg.value)
 
-        frame = Frame(
-            msg=msg, code=msg.data, gas=msg.gas, address=addr,
-            jumpdests=valid_jumpdests(msg.data),
-        )
         # init code runs with empty calldata
-        frame.msg = Message(
+        init_msg = Message(
             caller=msg.caller, target=addr, value=msg.value, data=b"",
             gas=msg.gas, is_static=msg.is_static, depth=msg.depth,
         )
-        result = self._run(frame)
+        result = self._execute_code(msg.data, init_msg, addr)
         if not result.success:
             state.revert_to(snapshot)
             result.create_address = None
@@ -246,6 +238,24 @@ class Evm:
     # ------------------------------------------------------------------
     # interpreter loop
     # ------------------------------------------------------------------
+
+    def _execute_code(self, code: bytes, msg: Message, address: bytes) -> ExecResult:
+        """Run one frame of bytecode on the selected EVM backend: the C++
+        core (native/evm.cc, mirroring the reference's evmone-behind-EVMC
+        split) or this module's Python interpreter."""
+        from phant_tpu.backend import evm_backend
+
+        if evm_backend() == "native":
+            from phant_tpu.evm.native_vm import execute_native
+
+            result = execute_native(self, code, msg, address)
+            if result is not None:
+                return result  # None: toolchain unavailable, fall through
+        frame = Frame(
+            msg=msg, code=code, gas=msg.gas, address=address,
+            jumpdests=valid_jumpdests(code),
+        )
+        return self._run(frame)
 
     def _run(self, frame: Frame) -> ExecResult:
         try:

@@ -32,7 +32,9 @@ class RunStats:
 
 def run_fixture(fixture: Fixture) -> None:
     """Raises FixtureFailure on any divergence from the fixture oracle."""
-    state = StateDB(dict(fixture.pre))
+    # deep-copy the pre-state: execution mutates Account objects in place,
+    # and a fixture may be run more than once (e.g. per EVM backend)
+    state = StateDB({addr: acct.copy() for addr, acct in fixture.pre.items()})
     genesis = Block.decode(fixture.genesis_rlp)
 
     chain = Blockchain(

@@ -252,3 +252,61 @@ def test_truncated_push_zero_extends():
     result = evm._run(frame)
     assert result.success
     assert frame.stack == [0xAA00]
+
+
+# --- cross-backend differential edge cases ---------------------------------
+
+
+@pytest.fixture(params=["python", "native"])
+def both_backends(request):
+    from phant_tpu.backend import set_evm_backend
+    from phant_tpu.evm.native_vm import native_available
+
+    if request.param == "native" and not native_available():
+        pytest.skip("native toolchain unavailable")
+    set_evm_backend(request.param)
+    yield request.param
+    set_evm_backend("python")
+
+
+def _run_code(code: bytes, data: bytes = b"", gas: int = 200_000):
+    state = StateDB({SENDER: Account(balance=10**18), OTHER: Account(code=code)})
+    state.start_tx()
+    evm = Evm(_env(state))
+    return evm.execute_message(
+        Message(caller=SENDER, target=OTHER, value=0, data=data, gas=gas)
+    )
+
+
+def test_calldatacopy_huge_src_zero_fills(both_backends):
+    """src near 2^64 must zero-fill, not wrap around into real calldata."""
+    code = (
+        b"\x60\x0a"                      # PUSH1 10 (size)
+        b"\x67\xff\xff\xff\xff\xff\xff\xff\xf8"  # PUSH8 src
+        b"\x60\x00"                      # PUSH1 0 (dest)  -- order: dest,src,size popped
+        b"\x37"                          # CALLDATACOPY
+        b"\x60\x20\x60\x00\xf3"          # RETURN mem[0:32]
+    )
+    # note stack order: CALLDATACOPY pops dest, src, size -> push size, src, dest
+    result = _run_code(code, data=b"\xaa" * 32)
+    assert result.success, result.error
+    assert result.output == b"\x00" * 32  # all zero-filled, nothing wrapped
+
+
+def test_returndatacopy_overflowing_bounds_fails(both_backends):
+    """src+size overflowing 64 bits must be an exceptional halt, not a read."""
+    # call the identity precompile to get 4 bytes of return data first
+    # (push order: ret_size, ret_off, in_size, in_off, addr, gas)
+    code = (
+        b"\x60\x00\x60\x00\x60\x04\x60\x00\x60\x04\x61\xff\xff\xfa"
+        # STATICCALL(gas=0xffff, addr=4, in=0..4, out=0..0) -> retdata = 4 bytes
+        b"\x50"                          # POP status
+        b"\x60\x10"                      # PUSH1 16 (size)
+        b"\x67\xff\xff\xff\xff\xff\xff\xff\xf8"  # PUSH8 src (2^64-8)
+        b"\x60\x00"                      # PUSH1 0 (dest)
+        b"\x3e"                          # RETURNDATACOPY
+        b"\x00"                          # STOP (unreachable)
+    )
+    result = _run_code(code, data=b"\x01\x02\x03\x04")
+    assert not result.success
+    assert result.gas_left == 0  # exceptional halt consumes everything
