@@ -88,9 +88,9 @@ def validate_signature_fields(r: int, s: int, *, require_low_s: bool = True) -> 
         raise SignatureError("s too high (EIP-2)")
 
 
-def recover_pubkey(msg_hash: bytes, r: int, s: int, recovery_id: int) -> bytes:
-    """ecrecover -> 65-byte uncompressed pubkey (0x04 || X || Y)
-    (reference: src/crypto/ecdsa.zig:19-26)."""
+def recover_pubkey_python(msg_hash: bytes, r: int, s: int, recovery_id: int) -> bytes:
+    """Pure-Python ecrecover (the readable oracle for the native and TPU
+    backends) -> 65-byte uncompressed pubkey (0x04 || X || Y)."""
     if recovery_id not in (0, 1, 2, 3):
         raise SignatureError(f"bad recovery id {recovery_id}")
     validate_signature_fields(r, s, require_low_s=False)
@@ -108,6 +108,25 @@ def recover_pubkey(msg_hash: bytes, r: int, s: int, recovery_id: int) -> bytes:
     if Q is None:
         raise SignatureError("recovered point at infinity")
     return b"\x04" + Q[0].to_bytes(32, "big") + Q[1].to_bytes(32, "big")
+
+
+def recover_pubkey(msg_hash: bytes, r: int, s: int, recovery_id: int) -> bytes:
+    """ecrecover -> 65-byte uncompressed pubkey (0x04 || X || Y); native C++
+    fast path when the toolchain is available (reference links C
+    libsecp256k1 the same way, src/crypto/ecdsa.zig:19-26)."""
+    from phant_tpu.utils.native import load_native
+
+    native = load_native()
+    if native is not None:
+        if recovery_id not in (0, 1, 2, 3):
+            raise SignatureError(f"bad recovery id {recovery_id}")
+        if not (0 <= r < 2**256 and 0 <= s < 2**256):
+            raise SignatureError("r/s out of u256 range")
+        pub = native.ecrecover(msg_hash, r, s, recovery_id)
+        if pub is None:
+            raise SignatureError("unrecoverable signature")
+        return b"\x04" + pub
+    return recover_pubkey_python(msg_hash, r, s, recovery_id)
 
 
 def _rfc6979_k(msg_hash: bytes, private_key: int) -> int:

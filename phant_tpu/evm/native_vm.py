@@ -181,10 +181,30 @@ class NativeSession:
         self._cbs = {}  # prevent GC of CFUNCTYPE trampolines
         self.host = PhantHost()
         self.host.ctx = None
+        # int-returning callbacks need an explicit safe default; void ones
+        # return None regardless
+        int_cbs = {"access_account", "access_storage", "get_code_size", "is_empty"}
         for name in _CB:
-            cb = _CB[name](getattr(self, "_cb_" + name))
+            raw = getattr(self, "_cb_" + name)
+            guarded = self._guard(raw, 0 if name in int_cbs else None)
+            cb = _CB[name](guarded)
             self._cbs[name] = cb
             setattr(self.host, name, cb)
+
+    def _guard(self, fn, default):
+        """No exception may unwind through the C frame: ctypes would swallow
+        it and C++ would keep running on garbage. Stash the first error and
+        re-raise it from execute() once the C++ stack has unwound."""
+
+        def wrapped(*args):
+            try:
+                return fn(*args)
+            except BaseException as e:
+                if self._pending_exc is None:
+                    self._pending_exc = e
+                return default
+
+        return wrapped
 
     # --- state callbacks (the reference's EVMOneHost equivalents) ---------
 
@@ -255,6 +275,13 @@ class NativeSession:
 
         m = msg_p.contents
         res = res_p.contents
+        if self._pending_exc is not None:
+            # a host callback already failed: abort fast, don't run children
+            res.status = 2
+            res.gas_left = 0
+            res.output = None
+            res.output_len = 0
+            return
         data = ct.string_at(m.data, m.data_len) if m.data_len else b""
         kind = m.kind
         caller = bytes(m.caller)

@@ -117,9 +117,16 @@ class TxSigner:
         `get_sender` exactly (differential-tested)."""
         from phant_tpu.backend import crypto_backend
 
-        if crypto_backend() != "tpu" or not txs:
-            return [self.get_sender(tx) for tx in txs]
-        from phant_tpu.ops.secp256k1_jax import ecrecover_batch
+        if not txs:
+            return []
+        use_tpu = crypto_backend() == "tpu"
+        native = None
+        if not use_tpu:
+            from phant_tpu.utils.native import load_native
+
+            native = load_native()
+            if native is None:  # no toolchain: scalar pure-Python path
+                return [self.get_sender(tx) for tx in txs]
 
         msgs, rs, ss, recids = [], [], [], []
         for tx in txs:
@@ -129,7 +136,13 @@ class TxSigner:
             rs.append(r)
             ss.append(s)
             recids.append(rec_id)
-        out = ecrecover_batch(msgs, rs, ss, recids)
+        if use_tpu:
+            from phant_tpu.ops.secp256k1_jax import ecrecover_batch
+
+            out = ecrecover_batch(msgs, rs, ss, recids)
+        else:
+            # fused native batch: recover + keccak + address in one FFI call
+            out = native.ecrecover_batch(msgs, rs, ss, recids)
         bad = [i for i, a in enumerate(out) if a is None]
         if bad:
             raise SignatureError(f"unrecoverable signature at tx index {bad[0]}")

@@ -79,6 +79,17 @@ class NativeLib:
             ctypes.c_void_p,
         ]
         lib.phant_pack_keccak.restype = ctypes.c_int
+        lib.phant_ecrecover.argtypes = [
+            ctypes.c_char_p, ctypes.c_char_p, ctypes.c_char_p,
+            ctypes.c_int32, ctypes.c_char_p,
+        ]
+        lib.phant_ecrecover.restype = ctypes.c_int32
+        lib.phant_ecrecover_batch.argtypes = [
+            ctypes.c_char_p, ctypes.c_char_p, ctypes.c_char_p,
+            ctypes.POINTER(ctypes.c_int32), ctypes.c_size_t,
+            ctypes.c_char_p, ctypes.c_char_p,
+        ]
+        lib.phant_ecrecover_batch.restype = None
 
     def keccak256(self, data: bytes) -> bytes:
         out = ctypes.create_string_buffer(32)
@@ -122,6 +133,32 @@ class NativeLib:
         if rc != 0:
             raise ValueError(f"payload exceeds bucket bound {max_chunks}")
         return buf, nchunks
+
+    def ecrecover(self, msg_hash: bytes, r: int, s: int, recid: int) -> Optional[bytes]:
+        """64-byte uncompressed pubkey (X||Y) or None if unrecoverable
+        (reference scope: src/crypto/ecdsa.zig:19-26 via libsecp256k1)."""
+        out = ctypes.create_string_buffer(64)
+        rc = self._lib.phant_ecrecover(
+            msg_hash, r.to_bytes(32, "big"), s.to_bytes(32, "big"), recid, out
+        )
+        return out.raw if rc == 0 else None
+
+    def ecrecover_batch(self, msg_hashes, rs, ss, recids):
+        """[(address|None)] for each signature: recover + keccak + slice."""
+        n = len(msg_hashes)
+        if n == 0:
+            return []
+        msgs = b"".join(msg_hashes)
+        r_blob = b"".join(v.to_bytes(32, "big") for v in rs)
+        s_blob = b"".join(v.to_bytes(32, "big") for v in ss)
+        recid_arr = (ctypes.c_int32 * n)(*recids)
+        addrs = ctypes.create_string_buffer(20 * n)
+        ok = ctypes.create_string_buffer(n)
+        self._lib.phant_ecrecover_batch(
+            msgs, r_blob, s_blob, recid_arr, n, addrs, ok
+        )
+        raw, okb = addrs.raw, ok.raw
+        return [raw[20 * i : 20 * i + 20] if okb[i] else None for i in range(n)]
 
     def keccak256_batch(self, payloads: Sequence[bytes]) -> List[bytes]:
         n = len(payloads)

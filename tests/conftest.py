@@ -29,3 +29,20 @@ if os.environ.get("PHANT_TEST_TPU", "0") in ("", "0"):
     import jax
 
     jax.config.update("jax_platforms", "cpu")
+
+
+import pytest
+
+
+@pytest.fixture(params=["python", "native"])
+def evm_backend(request):
+    """Run a test on both EVM backends — the Python interpreter and the C++
+    core (the reference's evmone analog) must agree bit-for-bit."""
+    from phant_tpu.backend import set_evm_backend
+    from phant_tpu.evm.native_vm import native_available
+
+    if request.param == "native" and not native_available():
+        pytest.skip("native toolchain unavailable")
+    set_evm_backend(request.param)
+    yield request.param
+    set_evm_backend("python")

@@ -257,18 +257,6 @@ def test_truncated_push_zero_extends():
 # --- cross-backend differential edge cases ---------------------------------
 
 
-@pytest.fixture(params=["python", "native"])
-def both_backends(request):
-    from phant_tpu.backend import set_evm_backend
-    from phant_tpu.evm.native_vm import native_available
-
-    if request.param == "native" and not native_available():
-        pytest.skip("native toolchain unavailable")
-    set_evm_backend(request.param)
-    yield request.param
-    set_evm_backend("python")
-
-
 def _run_code(code: bytes, data: bytes = b"", gas: int = 200_000):
     state = StateDB({SENDER: Account(balance=10**18), OTHER: Account(code=code)})
     state.start_tx()
@@ -278,7 +266,7 @@ def _run_code(code: bytes, data: bytes = b"", gas: int = 200_000):
     )
 
 
-def test_calldatacopy_huge_src_zero_fills(both_backends):
+def test_calldatacopy_huge_src_zero_fills(evm_backend):
     """src near 2^64 must zero-fill, not wrap around into real calldata."""
     code = (
         b"\x60\x0a"                      # PUSH1 10 (size)
@@ -293,7 +281,7 @@ def test_calldatacopy_huge_src_zero_fills(both_backends):
     assert result.output == b"\x00" * 32  # all zero-filled, nothing wrapped
 
 
-def test_returndatacopy_overflowing_bounds_fails(both_backends):
+def test_returndatacopy_overflowing_bounds_fails(evm_backend):
     """src+size overflowing 64 bits must be an exceptional halt, not a read."""
     # call the identity precompile to get 4 bytes of return data first
     # (push order: ret_size, ret_off, in_size, in_off, addr, gas)
@@ -310,3 +298,26 @@ def test_returndatacopy_overflowing_bounds_fails(both_backends):
     result = _run_code(code, data=b"\x01\x02\x03\x04")
     assert not result.success
     assert result.gas_left == 0  # exceptional halt consumes everything
+
+
+def test_native_host_exception_propagates():
+    """A host-side Python error during native execution must re-raise after
+    the C++ stack unwinds — not read as an ordinary in-EVM call failure."""
+    from phant_tpu.backend import set_evm_backend
+    from phant_tpu.evm.native_vm import native_available
+
+    if not native_available():
+        pytest.skip("native toolchain unavailable")
+    code = b"\x60\x00\x54\x00"  # PUSH1 0; SLOAD; STOP
+    state = StateDB({SENDER: Account(balance=1), OTHER: Account(code=code)})
+    state.start_tx()
+    evm = Evm(_env(state))
+    state.get_storage = lambda addr, slot: (_ for _ in ()).throw(RuntimeError("boom"))
+    set_evm_backend("native")
+    try:
+        with pytest.raises(RuntimeError, match="boom"):
+            evm.execute_message(
+                Message(caller=SENDER, target=OTHER, value=0, data=b"", gas=100_000)
+            )
+    finally:
+        set_evm_backend("python")

@@ -6,25 +6,12 @@ from pathlib import Path
 
 import pytest
 
-from phant_tpu.backend import set_evm_backend
-from phant_tpu.evm.native_vm import native_available
 from phant_tpu.spec.fixtures import walk_fixtures
 from phant_tpu.spec.runner import run_fixture
 
 FIXTURES = Path(__file__).parent / "fixtures"
 
 ALL = [(p.name, fx) for p, fx in walk_fixtures(FIXTURES)]
-
-
-@pytest.fixture(params=["python", "native"])
-def evm_backend(request):
-    """Every fixture runs on both EVM backends — the Python interpreter and
-    the C++ core (the reference's evmone analog) must agree bit-for-bit."""
-    if request.param == "native" and not native_available():
-        pytest.skip("native toolchain unavailable")
-    set_evm_backend(request.param)
-    yield request.param
-    set_evm_backend("python")
 
 
 @pytest.mark.parametrize(
