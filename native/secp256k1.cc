@@ -103,9 +103,9 @@ inline U256 p_sub(const U256& a, const U256& b) {
   return sub_raw(t, b);
 }
 
-// full 512-bit product then two folds
-U256 p_mul(const U256& a, const U256& b) {
-  uint64_t lo[8] = {0};
+// schoolbook 512-bit product (shared by the p- and n- multiplies)
+inline void mul_wide(const U256& a, const U256& b, uint64_t lo[8]) {
+  std::memset(lo, 0, 8 * sizeof(uint64_t));
   for (int i = 0; i < 4; ++i) {
     u128 carry = 0;
     for (int j = 0; j < 4; ++j) {
@@ -115,6 +115,12 @@ U256 p_mul(const U256& a, const U256& b) {
     }
     lo[i + 4] = (uint64_t)carry;
   }
+}
+
+// full 512-bit product then two folds
+U256 p_mul(const U256& a, const U256& b) {
+  uint64_t lo[8];
+  mul_wide(a, b, lo);
   // fold: result = L + H * kPFold  (H < 2^256, kPFold < 2^33 -> < 2^290)
   uint64_t acc[5] = {lo[0], lo[1], lo[2], lo[3], 0};
   u128 c = 0;
@@ -184,16 +190,8 @@ U256 n_mod_words(const uint64_t* words, int nwords) {
 }
 
 U256 n_mul(const U256& a, const U256& b) {
-  uint64_t lo[8] = {0};
-  for (int i = 0; i < 4; ++i) {
-    u128 carry = 0;
-    for (int j = 0; j < 4; ++j) {
-      carry += (u128)a.w[i] * b.w[j] + lo[i + j];
-      lo[i + j] = (uint64_t)carry;
-      carry >>= 64;
-    }
-    lo[i + 4] = (uint64_t)carry;
-  }
+  uint64_t lo[8];
+  mul_wide(a, b, lo);
   return n_mod_words(lo, 8);
 }
 

@@ -117,7 +117,9 @@ def recover_pubkey(msg_hash: bytes, r: int, s: int, recovery_id: int) -> bytes:
     from phant_tpu.utils.native import load_native
 
     native = load_native()
-    if native is not None:
+    # the C side reads exactly 32 bytes; odd-length hashes (legal for the
+    # Python path, which treats them as big-endian ints) stay in Python
+    if native is not None and len(msg_hash) == 32:
         if recovery_id not in (0, 1, 2, 3):
             raise SignatureError(f"bad recovery id {recovery_id}")
         if not (0 <= r < 2**256 and 0 <= s < 2**256):

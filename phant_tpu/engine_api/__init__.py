@@ -365,13 +365,22 @@ SUPPORTED_METHODS = (
 def handle_request(blockchain, request: dict) -> Tuple[int, dict]:
     """Dispatch one JSON-RPC request; returns (http_status, response_body)
     (reference: engineAPIHandler, main.zig:56-74)."""
+    from phant_tpu.utils.trace import metrics
+
     req_id = request.get("id")
     method = request.get("method", "")
     base = {"jsonrpc": "2.0", "id": req_id}
+    # bound counter cardinality: untrusted method strings share one bucket
+    if method in SUPPORTED_METHODS:
+        metrics.count(f"engine_api.{method}")
+    else:
+        metrics.count("engine_api.unknown_method")
     try:
         if method == "engine_newPayloadV2":
-            payload = payload_from_json(request["params"][0])
-            status = new_payload_v2_handler(blockchain, payload)
+            with metrics.phase("engine_api.decode_payload"):
+                payload = payload_from_json(request["params"][0])
+            with metrics.phase("engine_api.new_payload"):
+                status = new_payload_v2_handler(blockchain, payload)
             return 200, {**base, "result": status.to_json()}
         if method == "engine_getClientVersionV1":
             ver = get_client_version_v1_handler()

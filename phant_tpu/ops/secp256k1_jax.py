@@ -212,9 +212,10 @@ def _lt_const(a: jnp.ndarray, m: int) -> jnp.ndarray:
 
 def _pow_fixed(base: jnp.ndarray, exp_bits: np.ndarray, spec: _ModSpec) -> jnp.ndarray:
     """base^e for a fixed public exponent, square-and-multiply lax.scan."""
-    one = np.zeros(LIMBS, np.uint32)
-    one[0] = 1
-    acc0 = jnp.broadcast_to(jnp.asarray(one), base.shape)
+    # derive the initial accumulator from the input so it inherits the
+    # input's varying manual axes under shard_map (a fresh constant would be
+    # replicated and break the scan carry typing)
+    acc0 = (base ^ base).at[..., 0].set(1)
 
     def body(acc, bit):
         acc = _mul_mod(acc, acc, spec)
@@ -359,7 +360,9 @@ def ecrecover_kernel(e, r, s, parity):
     from phant_tpu.ops.keccak_jax import keccak256_chunked
 
     B = r.shape[0]
-    zero16 = jnp.zeros((B, LIMBS), jnp.uint32)
+    # varying-axes-safe zero (see _pow_fixed): shard_map scan carries must
+    # not start from replicated constants
+    zero16 = r ^ r
 
     # range checks (reference: src/crypto/ecdsa.zig:28-36, sans low-s which
     # is transaction policy, enforced by the signer layer)
@@ -417,7 +420,8 @@ def ecrecover_kernel(e, r, s, parity):
         S = _select_pt(skip, S, added)
         return S, None
 
-    S0 = (one_l, one_l, jnp.zeros_like(one_l))  # identity
+    one_v = zero16.at[:, 0].set(1)  # varying-axes-safe identity point
+    S0 = (one_v, one_v, zero16)
     Q, _ = jax.lax.scan(step, S0, (bits_u1, bits_u2))
 
     qx, qy, q_inf = _to_affine(*Q)

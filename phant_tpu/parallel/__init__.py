@@ -1,0 +1,17 @@
+"""Multi-chip / multi-host scaling (device meshes + sharded kernels)."""
+
+from phant_tpu.parallel.mesh import (
+    ecrecover_sharded,
+    init_distributed,
+    make_mesh,
+    shard_map,
+    witness_verify_sharded,
+)
+
+__all__ = [
+    "ecrecover_sharded",
+    "init_distributed",
+    "make_mesh",
+    "shard_map",
+    "witness_verify_sharded",
+]

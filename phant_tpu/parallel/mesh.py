@@ -1,0 +1,141 @@
+"""Multi-chip scaling: device meshes, sharded kernels, multi-host init.
+
+The reference is single-process and has no distributed backend (SURVEY §2:
+its only network surface is the HTTP Engine API, reference:
+src/main.zig:143-149). This framework's scale-out axis is data parallelism
+over blocks/nodes/signatures: a `jax.sharding.Mesh` with one `dp` axis,
+`shard_map`-ped kernels whose per-shard partial results are combined with
+XLA collectives over ICI (within a slice) / DCN (across slices), and
+`jax.distributed` for multi-host process groups — the TPU-native
+equivalent of a NCCL/MPI backend.
+
+Tested on a virtual 8-device CPU mesh (tests/test_parallel.py); the driver
+dry-runs the same path via __graft_entry__.dryrun_multichip.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from phant_tpu.ops.witness_jax import (
+    WITNESS_MAX_CHUNKS,
+    partial_verdict,
+    witness_digests,
+)
+
+if hasattr(jax, "shard_map"):  # jax >= 0.8 moved shard_map out of experimental
+    shard_map = jax.shard_map
+else:  # pragma: no cover - older jax
+    from jax.experimental.shard_map import shard_map  # type: ignore
+
+
+def make_mesh(n_devices: Optional[int] = None, axis: str = "dp") -> Mesh:
+    """1-D device mesh over the first n (default: all) local devices."""
+    devices = jax.devices()
+    if n_devices is not None:
+        if len(devices) < n_devices:
+            raise RuntimeError(
+                f"need {n_devices} devices but jax sees {len(devices)} "
+                f"({devices[0].platform}); set JAX_PLATFORMS=cpu and "
+                f"--xla_force_host_platform_device_count for a virtual mesh"
+            )
+        devices = devices[:n_devices]
+    return Mesh(np.array(devices), axis_names=(axis,))
+
+
+def init_distributed(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> None:
+    """Join a multi-host process group (the NCCL/MPI-equivalent bootstrap):
+    after this, jax.devices() spans every host's chips and the collectives
+    in the sharded kernels ride ICI/DCN. No-op arguments let TPU pods
+    auto-detect their topology."""
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+
+
+# ---------------------------------------------------------------------------
+# sharded witness verification (dp over the node axis)
+# ---------------------------------------------------------------------------
+
+
+def witness_verify_sharded(
+    mesh: Mesh,
+    blob,
+    meta,
+    roots,
+    *,
+    max_chunks: int = WITNESS_MAX_CHUNKS,
+    n_blocks: Optional[int] = None,
+):
+    """Per-block root-membership verdicts with the node axis sharded over
+    the mesh's `dp` axis. The blob and roots are replicated (nodes of one
+    block may land on any shard); each shard hashes its nodes and the
+    per-block partial verdicts are combined with a pmax collective.
+
+    meta columns must be divisible by the mesh size (pad_witness uses
+    power-of-two node counts, so any power-of-two mesh divides it).
+    """
+    if n_blocks is None:
+        n_blocks = int(roots.shape[0])
+    axis = mesh.axis_names[0]
+
+    @functools.partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(P(), P(None, axis), P()),
+        out_specs=P(),
+    )
+    def inner(blob_s, meta_s, roots_s):
+        offsets, lens, block_id = meta_s[0], meta_s[1], meta_s[2]
+        digests = witness_digests(blob_s, offsets, lens, max_chunks=max_chunks)
+        partial = partial_verdict(digests, lens, block_id, roots_s, n_blocks)
+        return jax.lax.pmax(partial, axis)
+
+    repl = NamedSharding(mesh, P())
+    blob_d = jax.device_put(jnp.asarray(blob), repl)
+    meta_d = jax.device_put(jnp.asarray(meta), NamedSharding(mesh, P(None, mesh.axis_names[0])))
+    roots_d = jax.device_put(jnp.asarray(roots), repl)
+    return jax.jit(inner)(blob_d, meta_d, roots_d) > 0
+
+
+# ---------------------------------------------------------------------------
+# sharded ecrecover (dp over the signature axis)
+# ---------------------------------------------------------------------------
+
+
+def ecrecover_sharded(mesh: Mesh, e, r, s, parity):
+    """Batched ecrecover with the signature axis sharded over `dp`. Each
+    shard runs the full fused kernel on its slice; outputs shard the same
+    way (no collective needed — recovery is embarrassingly parallel).
+
+    Batch size must be divisible by the mesh size (ecrecover_batch buckets
+    to powers of two, so any power-of-two mesh divides it).
+    """
+    from phant_tpu.ops.secp256k1_jax import ecrecover_kernel
+
+    axis = mesh.axis_names[0]
+
+    @functools.partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(P(axis), P(axis), P(axis), P(axis)),
+        out_specs=(P(axis), P(axis)),
+    )
+    def inner(e_s, r_s, s_s, p_s):
+        return ecrecover_kernel(e_s, r_s, s_s, p_s)
+
+    shard = NamedSharding(mesh, P(axis))
+    args = [jax.device_put(jnp.asarray(v), shard) for v in (e, r, s, parity)]
+    return jax.jit(inner)(*args)

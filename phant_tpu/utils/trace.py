@@ -1,0 +1,148 @@
+"""Tracing, metrics, and profiling.
+
+The reference's only observability is scoped debug logging (reference:
+std.log.scoped(.evmone)/(.vm) at src/blockchain/vm.zig:25,130 and the
+startup banner at src/main.zig:116-118); evmone's tracer is compiled but
+never installed (reference: build.zig:118). This framework upgrades that
+slot (SURVEY §5) to:
+
+- `phase(name)` — nestable wall-clock timers aggregated into a process
+  metrics registry (count / total / min / max per phase),
+- `metrics` — counters + timers with a `report()` table and `snapshot()`,
+- `jax_profile(logdir)` — a context manager around the JAX profiler for
+  device traces of the TPU kernels,
+- `scoped_logger(scope)` — the reference's scoped-logger idiom.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import logging
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Dict, Iterator, Optional
+
+
+def scoped_logger(scope: str) -> logging.Logger:
+    """(reference: std.log.scoped, e.g. src/blockchain/vm.zig:25)"""
+    return logging.getLogger(f"phant_tpu.{scope}")
+
+
+@dataclass
+class TimerStat:
+    count: int = 0
+    total_s: float = 0.0
+    min_s: float = float("inf")
+    max_s: float = 0.0
+
+    def add(self, dt: float) -> None:
+        self.count += 1
+        self.total_s += dt
+        self.min_s = min(self.min_s, dt)
+        self.max_s = max(self.max_s, dt)
+
+    @property
+    def mean_s(self) -> float:
+        return self.total_s / self.count if self.count else 0.0
+
+
+class Metrics:
+    """Process-global counters and phase timers (thread-safe)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._counters: Dict[str, int] = {}
+        self._timers: Dict[str, TimerStat] = {}
+
+    def count(self, name: str, delta: int = 1) -> None:
+        with self._lock:
+            self._counters[name] = self._counters.get(name, 0) + delta
+
+    def observe(self, name: str, seconds: float) -> None:
+        with self._lock:
+            self._timers.setdefault(name, TimerStat()).add(seconds)
+
+    @contextlib.contextmanager
+    def phase(self, name: str) -> Iterator[None]:
+        """Time a phase: `with metrics.phase("engine_api.new_payload"): ...`"""
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.observe(name, time.perf_counter() - t0)
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "counters": dict(self._counters),
+                "timers": {
+                    k: {
+                        "count": v.count,
+                        "total_s": v.total_s,
+                        "mean_s": v.mean_s,
+                        "min_s": v.min_s,
+                        "max_s": v.max_s,
+                    }
+                    for k, v in self._timers.items()
+                },
+            }
+
+    def reset(self) -> None:
+        with self._lock:
+            self._counters.clear()
+            self._timers.clear()
+
+    def report(self) -> str:
+        """Box table of every phase/counter (same presentation family as the
+        chain-config dump, reference: src/config/config.zig:67-90)."""
+        snap = self.snapshot()
+        rows = [("metric", "count", "total", "mean")]
+        for name, c in sorted(snap["counters"].items()):
+            rows.append((name, str(c), "-", "-"))
+        for name, t in sorted(snap["timers"].items()):
+            rows.append(
+                (
+                    name,
+                    str(t["count"]),
+                    f"{t['total_s'] * 1e3:.2f}ms",
+                    f"{t['mean_s'] * 1e3:.3f}ms",
+                )
+            )
+        widths = [max(len(r[i]) for r in rows) for i in range(4)]
+
+        def line(l, m, r):
+            return l + m.join("─" * (w + 2) for w in widths) + r
+
+        out = [line("┌", "┬", "┐")]
+        for i, row in enumerate(rows):
+            out.append("│" + "│".join(f" {c.ljust(w)} " for c, w in zip(row, widths)) + "│")
+            if i == 0:
+                out.append(line("├", "┼", "┤"))
+        out.append(line("└", "┴", "┘"))
+        return "\n".join(out)
+
+
+#: process-global registry (importable singleton)
+metrics = Metrics()
+
+
+def phase(name: str):
+    """Module-level shorthand for `metrics.phase(name)`."""
+    return metrics.phase(name)
+
+
+@contextlib.contextmanager
+def jax_profile(logdir: Optional[str] = None) -> Iterator[None]:
+    """Capture a JAX/XLA device trace (view with TensorBoard or Perfetto);
+    no-op when logdir is None so call sites can be left in production code."""
+    if logdir is None:
+        yield
+        return
+    import jax
+
+    jax.profiler.start_trace(logdir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()

@@ -175,6 +175,37 @@ def test_signer_batch_matches_scalar():
     assert signer.get_senders_batch(txs) == expected
 
 
+def test_ecrecover_sharded_matches_single():
+    """dp-sharded ecrecover over an 8-device mesh == single-device kernel."""
+    import jax.numpy as jnp
+
+    from phant_tpu.parallel import ecrecover_sharded, make_mesh
+
+    rng = np.random.default_rng(21)
+    B = 32
+    msgs, rs, ss, pars = [], [], [], []
+    for i in range(B):
+        key = int.from_bytes(rng.bytes(32), "big") % N or 1
+        msg = keccak256(rng.bytes(16 + i))
+        r, s, par = sign(msg, key)
+        msgs.append(int.from_bytes(msg, "big"))
+        rs.append(r)
+        ss.append(s)
+        pars.append(par)
+    e = sj.ints_to_limbs(msgs)
+    r_l = sj.ints_to_limbs(rs)
+    s_l = sj.ints_to_limbs(ss)
+    par_a = np.array(pars, np.uint32)
+
+    single_d, single_v = sj.ecrecover_kernel(
+        jnp.asarray(e), jnp.asarray(r_l), jnp.asarray(s_l), jnp.asarray(par_a)
+    )
+    mesh = make_mesh(8)
+    shard_d, shard_v = ecrecover_sharded(mesh, e, r_l, s_l, par_a)
+    assert (np.asarray(shard_v) == np.asarray(single_v)).all()
+    assert (np.asarray(shard_d) == np.asarray(single_d)).all()
+
+
 def test_ecrecover_eip155_canonical_vector():
     """The canonical EIP-155 example tx (chain id 1, nonce 9): known r/s
     constants, sender recovered on device must match the known address

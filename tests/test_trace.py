@@ -1,0 +1,75 @@
+"""Tracing/metrics subsystem tests (SURVEY §5 aux-subsystem slot)."""
+
+from __future__ import annotations
+
+import threading
+import time
+
+from phant_tpu.utils.trace import Metrics, jax_profile, metrics, scoped_logger
+
+
+def test_phase_timing_and_counters():
+    m = Metrics()
+    m.count("payloads")
+    m.count("payloads", 2)
+    with m.phase("work"):
+        time.sleep(0.01)
+    with m.phase("work"):
+        pass
+    snap = m.snapshot()
+    assert snap["counters"]["payloads"] == 3
+    t = snap["timers"]["work"]
+    assert t["count"] == 2
+    assert t["total_s"] >= 0.01
+    assert t["min_s"] <= t["mean_s"] <= t["max_s"]
+    report = m.report()
+    assert "payloads" in report and "work" in report
+    m.reset()
+    assert m.snapshot() == {"counters": {}, "timers": {}}
+
+
+def test_phase_records_on_exception():
+    m = Metrics()
+    try:
+        with m.phase("boom"):
+            raise ValueError("x")
+    except ValueError:
+        pass
+    assert m.snapshot()["timers"]["boom"]["count"] == 1
+
+
+def test_metrics_thread_safety():
+    m = Metrics()
+
+    def worker():
+        for _ in range(500):
+            m.count("n")
+            m.observe("t", 0.001)
+
+    threads = [threading.Thread(target=worker) for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    snap = m.snapshot()
+    assert snap["counters"]["n"] == 4000
+    assert snap["timers"]["t"]["count"] == 4000
+
+
+def test_jax_profile_noop_and_scoped_logger():
+    with jax_profile(None):  # must be a cheap no-op without a logdir
+        pass
+    assert scoped_logger("vm").name == "phant_tpu.vm"
+
+
+def test_engine_api_emits_metrics():
+    from phant_tpu.engine_api import handle_request
+
+    metrics.reset()
+    handle_request(None, {"id": 1, "method": "engine_bogusMethod"})
+    handle_request(None, {"id": 2, "method": "engine_getPayloadV2"})
+    snap = metrics.snapshot()
+    # untrusted method strings share one bucket (bounded cardinality);
+    # known methods get their own counter
+    assert snap["counters"]["engine_api.unknown_method"] == 1
+    assert snap["counters"]["engine_api.engine_getPayloadV2"] == 1
