@@ -1,0 +1,249 @@
+"""Device-side MPT root recomputation (level-by-level keccak).
+
+Recomputes a Merkle Patricia Trie root with every keccak256 on device: the
+host walks the built trie once and emits a *hash plan* — per-level RLP node
+templates with 32-byte holes where child digests belong — and the device
+then alternates (scatter child digests into the blob) -> (batched keccak of
+the level) until the root digest falls out. Host->device traffic is the
+template blob once plus tiny per-level index arrays; all hashing (the hot
+~90% of CPU root computation) happens on the chip.
+
+This is BASELINE.md metric #2 (state-root recompute): the reference computes
+roots serially on CPU (reference: src/mpt/mpt.zig:38-119, keccak per node)
+and skips state-root verification entirely (reference:
+src/blockchain/blockchain.zig:83-85).
+
+Scope: tries whose nodes all RLP-encode to >= 32 bytes (true for the secure
+state trie — account leaves are ~70B — and for receipt/tx tries of real
+blocks). Tries with embedded (<32B) nodes fall back to the CPU walk.
+"""
+
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from phant_tpu import rlp
+from phant_tpu.crypto.keccak import RATE
+from phant_tpu.mpt.mpt import (
+    BranchNode,
+    EMPTY_TRIE_ROOT,
+    ExtensionNode,
+    LeafNode,
+    Trie,
+    encode_hex_prefix,
+)
+from phant_tpu.ops.witness_jax import witness_digests
+
+# state-trie branch nodes are <= 17*33 + 2 bytes; 5 rate chunks cover 676B
+MPT_MAX_CHUNKS = 5
+
+_HOLE = object()  # placeholder for a child digest in a node template
+
+
+def _list_header(payload_len: int) -> bytes:
+    if payload_len < 56:
+        return bytes([0xC0 + payload_len])
+    ll = payload_len.to_bytes((payload_len.bit_length() + 7) // 8, "big")
+    return bytes([0xF7 + len(ll)]) + ll
+
+
+def _encode_template(items) -> Tuple[bytes, List[int]]:
+    """RLP-encode a node whose child refs are 32-byte holes; returns the
+    encoding (holes zeroed) and each hole's byte offset."""
+    payload = bytearray()
+    holes: List[int] = []
+    for it in items:
+        if it is _HOLE:
+            payload.append(0xA0)  # RLP string header for 32 bytes
+            holes.append(len(payload))
+            payload += b"\x00" * 32
+        else:
+            payload += rlp.encode(it)
+    header = _list_header(len(payload))
+    return bytes(header) + bytes(payload), [h + len(header) for h in holes]
+
+
+@dataclass
+class HashPlan:
+    """Per-level device layout for one trie."""
+
+    blob: np.ndarray  # (L,) uint8 — all templates + gather/scatter slack
+    # per level: offsets (n,), lens (n,), hole_pos (h,), hole_child (h,)
+    levels: List[Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]]
+    n_nodes: int  # total real nodes (root has global index n_nodes - 1)
+
+
+def _pow2(n: int) -> int:
+    p = 1
+    while p < max(n, 1):
+        p *= 2
+    return p
+
+
+def build_hash_plan(trie: Trie) -> Optional[HashPlan]:
+    """Walk the trie into a HashPlan, or None when any node encodes < 32B
+    (embedded-node rule: those tries take the CPU path)."""
+    if trie.root is None:
+        return None
+
+    # post-order walk: child templates/levels before parents
+    entries: List[Tuple[int, bytes, List[Tuple[int, int]]]] = []  # (level, template, holes->global idx)
+    index_of: Dict[int, int] = {}
+    too_small = False
+
+    def visit(node) -> Tuple[int, int]:  # returns (global_idx, level)
+        nonlocal too_small
+        if id(node) in index_of:
+            gi = index_of[id(node)]
+            return gi, entries[gi][0]
+        if isinstance(node, LeafNode):
+            template, holes = _encode_template(
+                [encode_hex_prefix(node.path, True), node.value]
+            )
+            level = 0
+            hole_refs: List[Tuple[int, int]] = []
+        elif isinstance(node, ExtensionNode):
+            ci, clvl = visit(node.child)
+            template, holes = _encode_template(
+                [encode_hex_prefix(node.path, False), _HOLE]
+            )
+            level = clvl + 1
+            hole_refs = [(holes[0], ci)]
+        else:  # BranchNode
+            items: List = []
+            child_order: List[int] = []
+            level = 0
+            for child in node.children:
+                if child is None:
+                    items.append(b"")
+                else:
+                    ci, clvl = visit(child)
+                    items.append(_HOLE)
+                    child_order.append(ci)
+                    level = max(level, clvl)
+            items.append(node.value if node.value is not None else b"")
+            template, holes = _encode_template(items)
+            level += 1
+            hole_refs = list(zip(holes, child_order))
+        if len(template) < 32:
+            too_small = True
+        if len(template) > MPT_MAX_CHUNKS * RATE - 1:
+            too_small = True  # oversized node: CPU path (cannot happen for state tries)
+        gi = len(entries)
+        entries.append((level, template, hole_refs))
+        index_of[id(node)] = gi
+        return gi, level
+
+    root_idx, _root_level = visit(trie.root)
+    if too_small:
+        return None
+
+    # lay templates into one blob; group node indices by level
+    n = len(entries)
+    offsets = np.zeros(n, np.int64)
+    pos = 0
+    for gi, (_lvl, template, _holes) in enumerate(entries):
+        offsets[gi] = pos
+        pos += len(template)
+    blob = np.zeros(pos + MPT_MAX_CHUNKS * RATE, np.uint8)
+    for gi, (_lvl, template, _holes) in enumerate(entries):
+        blob[offsets[gi] : offsets[gi] + len(template)] = np.frombuffer(
+            template, np.uint8
+        )
+
+    max_level = max(lvl for lvl, _t, _h in entries)
+    levels = []
+    # global digest indices are assigned densely level by level: remap
+    remap = np.zeros(n, np.int64)
+    next_global = 0
+    scratch = len(blob) - 32  # scatter target for hole padding rows
+    for lvl in range(max_level + 1):
+        idxs = [gi for gi in range(n) if entries[gi][0] == lvl]
+        for gi in idxs:
+            remap[gi] = next_global
+            next_global += 1
+        npad = _pow2(len(idxs))
+        off = np.zeros(npad, np.int32)
+        ln = np.zeros(npad, np.int32)
+        for k, gi in enumerate(idxs):
+            off[k] = offsets[gi]
+            ln[k] = len(entries[gi][1])
+        hp: List[int] = []
+        hc: List[int] = []
+        for gi in idxs:
+            for hole_off, child_gi in entries[gi][2]:
+                hp.append(int(offsets[gi]) + hole_off)
+                hc.append(int(remap[child_gi]))
+        hpad = _pow2(len(hp)) if hp else 1
+        hole_pos = np.full(hpad, scratch, np.int32)
+        hole_child = np.zeros(hpad, np.int32)
+        hole_pos[: len(hp)] = hp
+        hole_child[: len(hc)] = hc
+        levels.append((off, ln, hole_pos, hole_child))
+    assert remap[root_idx] == n - 1  # root is the unique top-level node
+    return HashPlan(blob=blob, levels=levels, n_nodes=n)
+
+
+# ---------------------------------------------------------------------------
+# device executor
+# ---------------------------------------------------------------------------
+
+
+@functools.partial(jax.jit, static_argnames=("max_chunks", "out_start"))
+def _hash_level(
+    blob, digests, offsets, lens, hole_pos, hole_child, *, max_chunks: int, out_start: int
+):
+    """Scatter referenced child digests into the blob, hash this level's
+    nodes, and append their digests to the global digest buffer."""
+    # digest words (C, 8) u32 -> bytes (C, 32) u8, little-endian per word
+    d = digests[hole_child]  # (H, 8)
+    shifts = jnp.arange(4, dtype=jnp.uint32) * 8
+    dbytes = ((d[:, :, None] >> shifts[None, None, :]) & 0xFF).astype(jnp.uint8)
+    dbytes = dbytes.reshape(d.shape[0], 32)
+    flat = hole_pos[:, None] + jnp.arange(32, dtype=jnp.int32)[None, :]
+    blob = blob.at[flat.reshape(-1)].set(dbytes.reshape(-1))
+    level_digests = witness_digests(blob, offsets, lens, max_chunks=max_chunks)
+    digests = jax.lax.dynamic_update_slice(
+        digests, level_digests, (out_start, 0)
+    )
+    return blob, digests
+
+
+def trie_root_device(trie: Trie, plan: Optional[HashPlan] = None) -> bytes:
+    """Trie root with all keccak hashing on device; CPU fallback for tries
+    with embedded nodes."""
+    if trie.root is None:
+        return EMPTY_TRIE_ROOT
+    if plan is None:
+        plan = build_hash_plan(trie)
+    if plan is None:
+        return trie.root_hash()
+
+    total_pad = sum(len(off) for off, _l, _p, _c in plan.levels)
+    blob = jnp.asarray(plan.blob)
+    digests = jnp.zeros((total_pad, 8), jnp.uint32)
+    out_start = 0
+    for off, ln, hole_pos, hole_child in plan.levels:
+        blob, digests = _hash_level(
+            blob,
+            digests,
+            jnp.asarray(off),
+            jnp.asarray(ln),
+            jnp.asarray(hole_pos),
+            jnp.asarray(hole_child),
+            max_chunks=MPT_MAX_CHUNKS,
+            out_start=out_start,
+        )
+        out_start += len(off)
+    # the root is the last REAL node hashed in the top level (padding rows
+    # sit after it within the level's pow2 bucket)
+    top_off, _ln, _hp, _hc = plan.levels[-1]
+    n_top_real = plan.n_nodes - (out_start - len(top_off))
+    root_words = np.asarray(digests[out_start - len(top_off) + n_top_real - 1])
+    return np.asarray(root_words, dtype="<u4").tobytes()
