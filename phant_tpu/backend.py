@@ -30,6 +30,34 @@ def crypto_backend() -> str:
     return _CRYPTO_BACKEND
 
 
+_JAX_DEVICE_OK: bool | None = None
+
+
+def jax_device_ok() -> bool:
+    """Whether running the jax kernels is sensible on this host.
+
+    The jax ecrecover kernel on a plain CPU is ~40x slower than the fused
+    native batch — if `--crypto_backend=tpu` is set but no accelerator is
+    attached, block validation must fall back to the native path rather than
+    quietly regress. An accelerator counts; so does an explicitly requested
+    CPU-mesh run (PHANT_ALLOW_JAX_CPU=1, used by the differential test suite
+    and the multi-chip dryrun, where the virtual CPU mesh is the point).
+    """
+    global _JAX_DEVICE_OK
+    import os
+
+    if os.environ.get("PHANT_ALLOW_JAX_CPU", "0") not in ("", "0"):
+        return True
+    if _JAX_DEVICE_OK is None:
+        try:
+            import jax
+
+            _JAX_DEVICE_OK = jax.default_backend() != "cpu"
+        except Exception:
+            _JAX_DEVICE_OK = False
+    return _JAX_DEVICE_OK
+
+
 def set_evm_backend(name: str) -> None:
     global _EVM_BACKEND
     if name not in _VALID_EVM:

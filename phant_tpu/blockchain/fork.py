@@ -16,6 +16,17 @@ from phant_tpu.state.statedb import StateDB
 HISTORY_STORAGE_ADDRESS = bytes.fromhex("0000f90827f1c53a10cb7a02335b175320002935")
 HISTORY_SERVE_WINDOW = 8191
 
+# The EIP-2935 system contract's deployed runtime bytecode (from the EIP's
+# deployment transaction): get path returns the ring-buffer slot for a
+# requested ancestor within the 8191-block serve window; set path (caller ==
+# 0xff..fe system address) writes block.number-1's hash. The reference
+# deploys real code too (reference: src/blockchain/forks/prague.zig:54-57).
+HISTORY_CONTRACT_CODE = bytes.fromhex(
+    "3373fffffffffffffffffffffffffffffffffffffffe14604657602036036042"
+    "575f35600143038111604257611fff81430311604257611fff9006545f5260205f"
+    "f35b5f5ffd5b5f35611fff60014303065500"
+)
+
 
 class Fork:
     """BLOCKHASH provider interface (reference: fork.zig:9-13)."""
@@ -65,8 +76,7 @@ class PragueFork(Fork):
         if not self.state.get_code(HISTORY_STORAGE_ADDRESS):
             self.state.create_account(HISTORY_STORAGE_ADDRESS)
             self.state.set_nonce(HISTORY_STORAGE_ADDRESS, 1)
-            # placeholder body; spec contract bytecode is immaterial here
-            self.state.set_code(HISTORY_STORAGE_ADDRESS, b"\x00")
+            self.state.set_code(HISTORY_STORAGE_ADDRESS, HISTORY_CONTRACT_CODE)
 
     def update_parent_block_hash(self, number: int, block_hash: bytes) -> None:
         slot = number % HISTORY_SERVE_WINDOW

@@ -85,6 +85,14 @@ class StatelessPayloadStatusV1:
     receipt_root: bytes
     validator_error: Optional[str] = None
 
+    def to_json(self) -> dict:
+        return {
+            "status": self.status,
+            "stateRoot": bytes_to_hex(self.state_root),
+            "receiptsRoot": bytes_to_hex(self.receipt_root),
+            "validationError": self.validator_error,
+        }
+
 
 @dataclass
 class BlobAndProofV1:
@@ -318,6 +326,85 @@ def new_payload_v2_handler(blockchain, payload: ExecutionPayload) -> PayloadStat
     return PayloadStatusV1(status="VALID", latest_valid_hash=computed_hash)
 
 
+def execute_stateless_payload_v1_handler(
+    blockchain, payload: ExecutionPayload, witness_json: dict
+) -> StatelessPayloadStatusV1:
+    """`engine_executeStatelessPayloadV1`: execute the payload against ONLY
+    its witness — linked multiproof verification (the TPU-batched flagship
+    kernel when `--crypto_backend=tpu`), lazy witness-backed state, full
+    block execution, and post-state-root recompute over the partial trie
+    (phant_tpu/stateless.py). The reference lists this method but never
+    implements it (reference: src/main.zig:24-54 vs main.zig:58-70).
+
+    witness_json: {"headers": ["0x<parent header rlp>", ...],
+    "state": ["0x<node rlp>", ...], "codes": ["0x<bytecode>", ...],
+    "preStateRoot": "0x.." (optional — defaults to the parent header's
+    stateRoot)} — the geth-style stateless witness shape. When a parent
+    header is shipped in the witness, the payload executes against IT, not
+    against the node's resident head: a stateless call must be able to
+    verify a non-head block.
+    """
+    from phant_tpu import rlp
+    from phant_tpu.blockchain.chain import BlockError
+    from phant_tpu.stateless import StatelessError, execute_stateless
+
+    zero = b"\x00" * 32
+    block = payload.to_block()
+    computed_hash = block.header.hash()
+    if computed_hash != payload.block_hash:
+        return StatelessPayloadStatusV1(
+            status="INVALID",
+            state_root=zero,
+            receipt_root=zero,
+            validator_error=(
+                f"blockHash mismatch: payload {payload.block_hash.hex()}, "
+                f"computed {computed_hash.hex()}"
+            ),
+        )
+    try:
+        headers = witness_json.get("headers") or []
+        if headers:
+            parent = BlockHeader.from_rlp_list(rlp.decode(hex_to_bytes(headers[0])))
+            if parent.hash() != block.header.parent_hash:
+                return StatelessPayloadStatusV1(
+                    status="INVALID",
+                    state_root=zero,
+                    receipt_root=zero,
+                    validator_error="witness parent header does not match payload parentHash",
+                )
+        else:
+            parent = blockchain.parent_header
+        if "preStateRoot" in witness_json:
+            pre_root = hex_to_hash(witness_json["preStateRoot"])
+        else:
+            pre_root = parent.state_root
+        nodes = [hex_to_bytes(n) for n in witness_json.get("state", [])]
+        codes = [hex_to_bytes(c) for c in witness_json.get("codes", [])]
+        # fork=None -> a fresh FrontierFork: the node's fork instance may be
+        # bound to the node's own StateDB (PragueFork writes EIP-2935 slots),
+        # and a stateless run must not touch resident state
+        _result, post_root = execute_stateless(
+            blockchain.chain_id,
+            parent,
+            block,
+            pre_root,
+            nodes,
+            codes,
+        )
+    except (StatelessError, BlockError) as e:
+        return StatelessPayloadStatusV1(
+            status="INVALID",
+            state_root=zero,
+            receipt_root=zero,
+            validator_error=str(e),
+        )
+    return StatelessPayloadStatusV1(
+        status="VALID",
+        state_root=post_root,
+        receipt_root=block.header.receipts_root,
+    )
+
+
 def get_client_version_v1_handler() -> ClientVersionV1:
     """(reference: execution_payload.zig:206-213)"""
     return ClientVersionV1(
@@ -325,10 +412,10 @@ def get_client_version_v1_handler() -> ClientVersionV1:
     )
 
 
-# The full supported-method list (reference: main.zig:24-54). Only the two
-# starred methods have real handlers, exactly like the reference
-# (main.zig:58-70); the rest return a JSON-RPC error (reference replies
-# HTTP 500, main.zig:72).
+# The full supported-method list (reference: main.zig:24-54). The starred
+# methods have real handlers — the reference implements two (main.zig:58-70);
+# executeStatelessPayloadV1 is implemented beyond it. The rest return a
+# JSON-RPC error (reference replies HTTP 500, main.zig:72).
 SUPPORTED_METHODS = (
     "engine_forkchoiceUpdatedV1",
     "engine_forkchoiceUpdatedV2",
@@ -350,7 +437,7 @@ SUPPORTED_METHODS = (
     "engine_newPayloadWithWitnessV2",
     "engine_newPayloadWithWitnessV3",
     "engine_newPayloadWithWitnessV4",
-    "engine_executeStatelessPayloadV1",
+    "engine_executeStatelessPayloadV1",  # * implemented (beyond reference)
     "engine_executeStatelessPayloadV2",
     "engine_executeStatelessPayloadV3",
     "engine_executeStatelessPayloadV4",
@@ -382,6 +469,15 @@ def handle_request(blockchain, request: dict) -> Tuple[int, dict]:
             with metrics.phase("engine_api.new_payload"):
                 status = new_payload_v2_handler(blockchain, payload)
             return 200, {**base, "result": status.to_json()}
+        if method == "engine_executeStatelessPayloadV1":
+            with metrics.phase("engine_api.decode_payload"):
+                payload = payload_from_json(request["params"][0])
+                witness_json = request["params"][1]
+            with metrics.phase("engine_api.execute_stateless"):
+                sstatus = execute_stateless_payload_v1_handler(
+                    blockchain, payload, witness_json
+                )
+            return 200, {**base, "result": sstatus.to_json()}
         if method == "engine_getClientVersionV1":
             ver = get_client_version_v1_handler()
             return 200, {**base, "result": [ver.to_json()]}

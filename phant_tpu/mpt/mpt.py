@@ -4,7 +4,7 @@ Equivalent surface to the reference (reference: src/mpt/mpt.zig:13-314):
 `keyval` pairs -> trie -> keccak root, with hex-prefix nibble encoding and
 the <32-byte node-embedding rule. Goes beyond the reference by also keeping
 the built node structure around for proof generation (phant_tpu/mpt/proof.py)
-and for the TPU level-order hashing pipeline (phant_tpu/ops/mpt_pack.py):
+and for the TPU level-order hashing pipeline (phant_tpu/ops/mpt_jax.py):
 the reference computes roots only (reference: src/mpt/mpt.zig:38-45).
 
 Yellow-paper appendix D. Node kinds: leaf, extension, branch, empty.
@@ -153,6 +153,9 @@ class Trie:
 
     def __init__(self):
         self.root: Optional[Node] = None
+        # upper bound on leaf count (overwrites double-count); used only as
+        # the device-dispatch size heuristic in trie_root_hash
+        self.approx_size = 0
         # node-id -> (structure, encoding) memo; valid only between mutations
         # (cleared on put; ids are stable while the trie is read-only).
         self._enc_cache: Dict[int, Tuple[rlp.RLPItem, bytes]] = {}
@@ -161,6 +164,7 @@ class Trie:
         if not value:
             raise ValueError("MPT deletion (empty value) not supported in builder")
         self._enc_cache.clear()
+        self.approx_size += 1
         self.root = _insert(self.root, bytes_to_nibbles(key), value)
 
     def get(self, key: bytes) -> Optional[bytes]:
@@ -224,6 +228,35 @@ class Trie:
 # --- public API mirroring the reference ----------------------------------
 
 
+def trie_root_hash(trie: Trie) -> bytes:
+    """Root of a built trie through the selected crypto backend: device
+    level-order hashing on `--crypto_backend=tpu` (phant_tpu/ops/mpt_jax.py,
+    with automatic host fallback for embedded-node tries), host recursion
+    otherwise. This is the root used by the block path
+    (phant_tpu/blockchain/chain.py) and the state root (phant_tpu/state/root.py).
+
+    Tiny tries (a handful of txs/receipts) stay on the host even on the tpu
+    backend: per-level dispatch latency would dwarf the hashing. The
+    threshold is leaf-count based (PHANT_TPU_MIN_TRIE, default 192)."""
+    from phant_tpu.backend import crypto_backend, jax_device_ok
+
+    if (
+        crypto_backend() == "tpu"
+        and trie.approx_size >= _min_device_trie()
+        and jax_device_ok()
+    ):
+        from phant_tpu.ops.mpt_jax import trie_root_device
+
+        return trie_root_device(trie)
+    return trie.root_hash()
+
+
+def _min_device_trie() -> int:
+    import os
+
+    return int(os.environ.get("PHANT_TPU_MIN_TRIE", "192"))
+
+
 def trie_root(pairs: Iterable[Tuple[bytes, bytes]]) -> bytes:
     """Root of the trie mapping key bytes -> value bytes (values already RLP).
 
@@ -232,7 +265,7 @@ def trie_root(pairs: Iterable[Tuple[bytes, bytes]]) -> bytes:
     trie = Trie()
     for key, value in pairs:
         trie.put(key, value)
-    return trie.root_hash()
+    return trie_root_hash(trie)
 
 
 def ordered_trie_root(values: Sequence[bytes]) -> bytes:

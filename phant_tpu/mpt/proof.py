@@ -137,3 +137,70 @@ def verify_witness(
         if got != expected:
             return False
     return True
+
+
+def _child_refs(item: rlp.RLPItem) -> List[bytes]:
+    """32-byte child hash references of a decoded trie node, recursing into
+    embedded (<32B) children. Leaf and branch VALUES are not references."""
+    refs: List[bytes] = []
+    if not isinstance(item, list):
+        return refs
+    if len(item) == 17:
+        for child in item[:16]:
+            if isinstance(child, list):
+                refs.extend(_child_refs(child))
+            elif len(child) == 32:
+                refs.append(bytes(child))
+    elif len(item) == 2:
+        first = bytes(item[0])
+        if first and not (first[0] & 0x20):  # extension
+            child = item[1]
+            if isinstance(child, list):
+                refs.extend(_child_refs(child))
+            elif len(child) == 32:
+                refs.append(bytes(child))
+        elif first and not isinstance(item[1], list):
+            # leaf: an account-shaped value (4-string list, 32-byte items 2
+            # and 3) commits its storage root — storage-trie witness nodes
+            # link through it (mirrors the native/device scanners)
+            try:
+                body = rlp.decode(bytes(item[1]))
+            except Exception:
+                return refs
+            if (
+                isinstance(body, list)
+                and len(body) == 4
+                and all(not isinstance(x, list) for x in body)
+                and len(body[2]) == 32
+                and len(body[3]) == 32
+            ):
+                refs.append(bytes(body[2]))
+    return refs
+
+
+def verify_witness_linked(root: bytes, proof_nodes: Sequence[bytes]) -> bool:
+    """Full structural witness check on host: the nodes must form a connected
+    subtree rooted at `root` — every node reachable from the root via hash
+    references (BFS through the node bag). This is the CPU baseline of the
+    device linkage verdict (phant_tpu/ops/witness_jax.py
+    witness_verify_linked); both reject a witness whose parent->child hash
+    chain is broken, not just one whose root is absent."""
+    if root == EMPTY_TRIE_ROOT:
+        return not list(proof_nodes)
+    db = _node_db(proof_nodes)
+    if root not in db:
+        return False
+    seen = {root}
+    frontier = [root]
+    while frontier:
+        nxt: List[bytes] = []
+        for digest in frontier:
+            enc = db.get(digest)
+            if enc is None:
+                continue  # child outside the witness: allowed (not proven)
+            for ref in _child_refs(rlp.decode(enc)):
+                if ref in db and ref not in seen:
+                    seen.add(ref)
+                    nxt.append(ref)
+        frontier = nxt
+    return len(seen) == len(db)

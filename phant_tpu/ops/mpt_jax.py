@@ -38,7 +38,7 @@ from phant_tpu.mpt.mpt import (
     Trie,
     encode_hex_prefix,
 )
-from phant_tpu.ops.witness_jax import witness_digests
+from phant_tpu.ops.witness_jax import _pow2ceil as _pow2, witness_digests
 
 # state-trie branch nodes are <= 17*33 + 2 bytes; 5 rate chunks cover 676B
 MPT_MAX_CHUNKS = 5
@@ -76,14 +76,8 @@ class HashPlan:
     blob: np.ndarray  # (L,) uint8 — all templates + gather/scatter slack
     # per level: offsets (n,), lens (n,), hole_pos (h,), hole_child (h,)
     levels: List[Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]]
-    n_nodes: int  # total real nodes (root has global index n_nodes - 1)
-
-
-def _pow2(n: int) -> int:
-    p = 1
-    while p < max(n, 1):
-        p *= 2
-    return p
+    n_nodes: int  # total real nodes
+    root_pos: int  # row of the root digest in the global digest buffer
 
 
 def build_hash_plan(trie: Trie) -> Optional[HashPlan]:
@@ -151,7 +145,9 @@ def build_hash_plan(trie: Trie) -> Optional[HashPlan]:
     for gi, (_lvl, template, _holes) in enumerate(entries):
         offsets[gi] = pos
         pos += len(template)
-    blob = np.zeros(pos + MPT_MAX_CHUNKS * RATE, np.uint8)
+    # pow2-pad the blob so repeated roots of similar tries hit a small set
+    # of compiled shapes (the slack region doubles as scatter scratch)
+    blob = np.zeros(_pow2(pos + MPT_MAX_CHUNKS * RATE), np.uint8)
     for gi, (_lvl, template, _holes) in enumerate(entries):
         blob[offsets[gi] : offsets[gi] + len(template)] = np.frombuffer(
             template, np.uint8
@@ -159,15 +155,16 @@ def build_hash_plan(trie: Trie) -> Optional[HashPlan]:
 
     max_level = max(lvl for lvl, _t, _h in entries)
     levels = []
-    # global digest indices are assigned densely level by level: remap
+    # digest rows are laid out level by level, each level padded to a power
+    # of two — remap must use the PADDED cumulative position, since that is
+    # where _hash_level actually writes each level's digests
     remap = np.zeros(n, np.int64)
     next_global = 0
     scratch = len(blob) - 32  # scatter target for hole padding rows
     for lvl in range(max_level + 1):
         idxs = [gi for gi in range(n) if entries[gi][0] == lvl]
-        for gi in idxs:
-            remap[gi] = next_global
-            next_global += 1
+        for k, gi in enumerate(idxs):
+            remap[gi] = next_global + k
         npad = _pow2(len(idxs))
         off = np.zeros(npad, np.int32)
         ln = np.zeros(npad, np.int32)
@@ -186,8 +183,14 @@ def build_hash_plan(trie: Trie) -> Optional[HashPlan]:
         hole_pos[: len(hp)] = hp
         hole_child[: len(hc)] = hc
         levels.append((off, ln, hole_pos, hole_child))
-    assert remap[root_idx] == n - 1  # root is the unique top-level node
-    return HashPlan(blob=blob, levels=levels, n_nodes=n)
+        next_global += npad
+    # the root is the unique max-level node (level(parent) > level(child)
+    # for every edge, and all nodes descend from the root)
+    top_real = [gi for gi in range(n) if entries[gi][0] == max_level]
+    assert top_real == [root_idx]
+    return HashPlan(
+        blob=blob, levels=levels, n_nodes=n, root_pos=int(remap[root_idx])
+    )
 
 
 # ---------------------------------------------------------------------------
@@ -195,12 +198,16 @@ def build_hash_plan(trie: Trie) -> Optional[HashPlan]:
 # ---------------------------------------------------------------------------
 
 
-@functools.partial(jax.jit, static_argnames=("max_chunks", "out_start"))
+@functools.partial(jax.jit, static_argnames=("max_chunks",))
 def _hash_level(
-    blob, digests, offsets, lens, hole_pos, hole_child, *, max_chunks: int, out_start: int
+    blob, digests, offsets, lens, hole_pos, hole_child, out_start, *, max_chunks: int
 ):
     """Scatter referenced child digests into the blob, hash this level's
-    nodes, and append their digests to the global digest buffer."""
+    nodes, and append their digests to the global digest buffer.
+
+    `out_start` is a traced scalar (not static) so one compiled program per
+    (level-shape, buffer-shape) serves every level position — a plan's levels
+    mostly share shapes, keeping compile count low on repeated roots."""
     # digest words (C, 8) u32 -> bytes (C, 32) u8, little-endian per word
     d = digests[hole_child]  # (H, 8)
     shifts = jnp.arange(4, dtype=jnp.uint32) * 8
@@ -210,7 +217,7 @@ def _hash_level(
     blob = blob.at[flat.reshape(-1)].set(dbytes.reshape(-1))
     level_digests = witness_digests(blob, offsets, lens, max_chunks=max_chunks)
     digests = jax.lax.dynamic_update_slice(
-        digests, level_digests, (out_start, 0)
+        digests, level_digests, (out_start, jnp.int32(0))
     )
     return blob, digests
 
@@ -237,13 +244,9 @@ def trie_root_device(trie: Trie, plan: Optional[HashPlan] = None) -> bytes:
             jnp.asarray(ln),
             jnp.asarray(hole_pos),
             jnp.asarray(hole_child),
+            jnp.int32(out_start),
             max_chunks=MPT_MAX_CHUNKS,
-            out_start=out_start,
         )
         out_start += len(off)
-    # the root is the last REAL node hashed in the top level (padding rows
-    # sit after it within the level's pow2 bucket)
-    top_off, _ln, _hp, _hc = plan.levels[-1]
-    n_top_real = plan.n_nodes - (out_start - len(top_off))
-    root_words = np.asarray(digests[out_start - len(top_off) + n_top_real - 1])
+    root_words = np.asarray(digests[plan.root_pos])
     return np.asarray(root_words, dtype="<u4").tobytes()

@@ -212,6 +212,7 @@ def _lt_const(a: jnp.ndarray, m: int) -> jnp.ndarray:
 
 def _pow_fixed(base: jnp.ndarray, exp_bits: np.ndarray, spec: _ModSpec) -> jnp.ndarray:
     """base^e for a fixed public exponent, square-and-multiply lax.scan."""
+    base = jnp.asarray(base)
     # derive the initial accumulator from the input so it inherits the
     # input's varying manual axes under shard_map (a fresh constant would be
     # replicated and break the scan carry typing)

@@ -30,6 +30,13 @@ from phant_tpu.ops.keccak_jax import keccak256_chunked
 WITNESS_MAX_CHUNKS = 5
 
 
+def _pow2ceil(n: int) -> int:
+    p = 1
+    while p < max(n, 1):
+        p *= 2
+    return p
+
+
 @functools.partial(jax.jit, static_argnames=("max_chunks",))
 def witness_digests(
     blob: jax.Array,
@@ -106,6 +113,126 @@ def partial_verdict(digests, lens, block_id, roots, n_blocks: int):
 
 
 # ---------------------------------------------------------------------------
+# linked (full multiproof) verification
+# ---------------------------------------------------------------------------
+
+
+def _gather_refs(blob, ref_off):
+    """(M, 8) u32 little-endian words of the 32-byte refs at `ref_off`."""
+    idx = jnp.maximum(ref_off, 0)[:, None] + jnp.arange(32, dtype=jnp.int32)[None, :]
+    b = jnp.take(blob, idx, mode="clip").astype(jnp.uint32).reshape(-1, 8, 4)
+    return b[..., 0] | (b[..., 1] << 8) | (b[..., 2] << 16) | (b[..., 3] << 24)
+
+
+# sentinel block id for pad refs: matches nothing. A plain int (NOT a jnp
+# array) so importing this module for its host-side helpers never triggers
+# jax backend initialization (the axon-pinned-platform hazard).
+_DEAD_BLOCK = 2**30
+
+
+def _referenced(digests, block_id, refs, ref_block, ref_live):
+    """(N,) bool: node i's digest appears among its own block's child refs.
+
+    Exact 256-bit equality (soundness: a truncated fingerprint would let an
+    adversary link a foreign node with a crafted collision), computed as a
+    sort-join instead of an (N, M) compare matrix: stack refs and digests as
+    rows keyed by (block, 8 digest words), `lax.sort` lexicographically, mark
+    equal-key runs, and flag a digest row iff its run contains a live ref.
+    O((N+M) log(N+M)) work vs O(N*M*8) for the matrix — at bench shapes the
+    matrix would rival the keccak cost itself."""
+    N = digests.shape[0]
+    M = refs.shape[0]
+    block = jnp.concatenate(
+        [
+            jnp.where(ref_live, ref_block, jnp.int32(_DEAD_BLOCK)),
+            block_id.astype(jnp.int32),
+        ]
+    )
+    words = [jnp.concatenate([refs[:, k], digests[:, k]]) for k in range(8)]
+    is_digest = jnp.concatenate(
+        [jnp.zeros((M,), jnp.uint32), jnp.ones((N,), jnp.uint32)]
+    )
+    src = jnp.concatenate(
+        [jnp.full((M,), N, jnp.uint32), jnp.arange(N, dtype=jnp.uint32)]
+    )
+    sb, *sw, stag, ssrc = jax.lax.sort(
+        (block, *words, is_digest, src), num_keys=9
+    )
+    eq_prev = sb[1:] == sb[:-1]
+    for w in sw:
+        eq_prev = eq_prev & (w[1:] == w[:-1])
+    eq_prev = jnp.concatenate([jnp.zeros((1,), bool), eq_prev])
+    run_id = jnp.cumsum((~eq_prev).astype(jnp.int32)) - 1
+    live_ref_row = (stag == 0) & (sb < _DEAD_BLOCK)
+    run_has_ref = (
+        jnp.zeros((N + M,), jnp.int32).at[run_id].max(live_ref_row.astype(jnp.int32))
+    )
+    row_ref = run_has_ref[run_id] > 0
+    # scatter digest rows' flags back to node order (ref rows dump to slot N)
+    out = (
+        jnp.zeros((N + 1,), jnp.int32)
+        .at[jnp.where(stag == 1, ssrc, jnp.uint32(N))]
+        .max(row_ref.astype(jnp.int32))
+    )
+    return out[:N] > 0
+
+
+def linked_verdict(digests, lens, block_id, refs, ref_block, ref_live, roots, n_blocks: int):
+    """Per-block (root_hit, all_linked) partials as int32 arrays.
+
+    A block verifies iff some node hashes to its root AND every node is
+    either that root or hash-referenced by another witness node of the same
+    block. Hash references are acyclic (a cycle would be a keccak collision),
+    so this is exactly 'the witness is a connected subtree rooted at the
+    claimed root' — the real multiproof verdict, not just root membership.
+    Shared between the single-chip kernel and the dp-sharded path (which
+    combines partials with pmax/pmin over the mesh)."""
+    valid = lens > 0
+    is_root = jnp.all(digests == roots[block_id], axis=1) & valid
+    referenced = _referenced(digests, block_id, refs, ref_block, ref_live)
+    ok_node = (~valid) | is_root | referenced
+    root_hit = (
+        jnp.zeros((n_blocks,), jnp.int32).at[block_id].max(is_root.astype(jnp.int32))
+    )
+    all_ok = (
+        jnp.ones((n_blocks,), jnp.int32)
+        .at[jnp.where(valid, block_id, 0)]
+        .min(jnp.where(valid, ok_node, True).astype(jnp.int32))
+    )
+    return root_hit, all_ok
+
+
+@functools.partial(jax.jit, static_argnames=("max_chunks", "n_blocks"))
+def witness_verify_linked(
+    blob: jax.Array,
+    meta: jax.Array,
+    ref_meta: jax.Array,
+    roots: jax.Array,
+    *,
+    max_chunks: int,
+    n_blocks: int,
+) -> jax.Array:
+    """Full multiproof witness verification on device.
+
+    meta: (3, B) int32 — (offsets, lens, block_id) per node (0-len = pad).
+    ref_meta: (2, R) int32 — (blob offset, block_id) of every 32-byte child
+      hash reference inside the witness nodes (host-scanned, -1 offset = pad).
+    roots: (n_blocks, 8) uint32.
+
+    Returns (n_blocks,) bool. Unlike `witness_verify` (root membership only),
+    a block passes only if its nodes form a connected subtree rooted at the
+    expected root — a witness with a broken parent->child link is rejected.
+    """
+    offsets, lens, block_id = meta[0], meta[1], meta[2]
+    digests = witness_digests(blob, offsets, lens, max_chunks=max_chunks)
+    refs = _gather_refs(blob, ref_meta[0])
+    root_hit, all_ok = linked_verdict(
+        digests, lens, block_id, refs, ref_meta[1], ref_meta[0] >= 0, roots, n_blocks
+    )
+    return (root_hit > 0) & (all_ok > 0)
+
+
+# ---------------------------------------------------------------------------
 # host-side layout
 # ---------------------------------------------------------------------------
 
@@ -148,3 +275,147 @@ def pack_witness_blob(
 def roots_to_words(roots: Sequence[bytes]) -> np.ndarray:
     """(NB, 8) u32 little-endian view of 32-byte root hashes."""
     return np.stack([np.frombuffer(r, dtype="<u4") for r in roots])
+
+
+# --- child-ref extraction (host) ------------------------------------------
+
+
+def _rlp_item_bounds(data, end: int, pos: int):
+    """(kind, payload_start, payload_end, next_pos); kind 0=str, 1=list.
+    Mirrors the native scanner (native/packer.cc phant_scan_refs)."""
+    b = data[pos]
+    if b < 0x80:
+        return 0, pos, pos + 1, pos + 1
+    if b < 0xB8:
+        l, s, kind = b - 0x80, pos + 1, 0
+    elif b < 0xC0:
+        ll = b - 0xB7
+        l = int.from_bytes(bytes(data[pos + 1 : pos + 1 + ll]), "big")
+        s, kind = pos + 1 + ll, 0
+    elif b < 0xF8:
+        l, s, kind = b - 0xC0, pos + 1, 1
+    else:
+        ll = b - 0xF7
+        l = int.from_bytes(bytes(data[pos + 1 : pos + 1 + ll]), "big")
+        s, kind = pos + 1 + ll, 1
+    if s + l > end:
+        raise ValueError("malformed RLP in witness node")
+    return kind, s, s + l, s + l
+
+
+def _scan_list_refs(data, s: int, e: int, out: List[int], depth: int = 0) -> None:
+    if depth > 64:
+        raise ValueError("RLP nesting too deep")
+    items = []
+    pos = s
+    while pos < e:
+        kind, ps, pe, pos = _rlp_item_bounds(data, e, pos)
+        items.append((kind, ps, pe))
+        if len(items) > 17:
+            raise ValueError("not a trie node")
+    if len(items) == 17:
+        for kind, ps, pe in items[:16]:
+            if kind == 0 and pe - ps == 32:
+                out.append(ps)
+            elif kind == 1 and pe > ps:
+                _scan_list_refs(data, ps, pe, out, depth + 1)
+    elif len(items) == 2:
+        kind0, p0s, p0e = items[0]
+        if p0e == p0s:
+            raise ValueError("empty hex-prefix path")
+        if not (data[p0s] & 0x20):  # extension (leaf bit clear)
+            kind, ps, pe = items[1]
+            if kind == 0 and pe - ps == 32:
+                out.append(ps)
+            elif kind == 1:
+                _scan_list_refs(data, ps, pe, out, depth + 1)
+        else:  # leaf: an account-shaped value commits its storage root
+            kind, ps, pe = items[1]
+            if kind == 0:
+                sr = _account_storage_root_off(data, ps, pe)
+                if sr >= 0:
+                    out.append(sr)
+
+
+def _account_storage_root_off(data, s: int, e: int) -> int:
+    """Absolute offset of the storage root inside an account-shaped leaf
+    value (a 4-string RLP list with 32-byte items 2 and 3), else -1.
+    Mirrors native/packer.cc account_storage_root_off."""
+    try:
+        kind, ps, pe, nxt = _rlp_item_bounds(data, e, s)
+    except ValueError:
+        return -1
+    if kind != 1 or nxt != e:
+        return -1
+    spans = []
+    pos = ps
+    while pos < pe:
+        try:
+            k, ips, ipe, pos = _rlp_item_bounds(data, pe, pos)
+        except ValueError:
+            return -1
+        if k != 0 or len(spans) >= 4:
+            return -1
+        spans.append((ips, ipe))
+    if len(spans) != 4:
+        return -1
+    if spans[2][1] - spans[2][0] != 32 or spans[3][1] - spans[3][0] != 32:
+        return -1
+    return spans[2][0]
+
+
+def scan_refs_py(blob, offsets, lens) -> Tuple[np.ndarray, np.ndarray]:
+    """Pure-Python fallback for NativeLib.scan_refs: absolute blob offsets of
+    every child hash reference, with the owning node index."""
+    ref_off: List[int] = []
+    ref_node: List[int] = []
+    mv = memoryview(blob) if isinstance(blob, (bytes, bytearray)) else blob
+    for i in range(len(offsets)):
+        s, e = int(offsets[i]), int(offsets[i]) + int(lens[i])
+        kind, ps, pe, pos = _rlp_item_bounds(mv, e, s)
+        if kind != 1 or pos != e:
+            raise ValueError("witness node is not a single RLP list")
+        before = len(ref_off)
+        _scan_list_refs(mv, ps, pe, ref_off)
+        ref_node.extend([i] * (len(ref_off) - before))
+    return np.asarray(ref_off, np.int64), np.asarray(ref_node, np.int32)
+
+
+def pack_witness(
+    node_lists: Sequence[Sequence[bytes]],
+    max_chunks: int,
+    pad_nodes_to: int | None = None,
+    pad_refs_to: int | None = None,
+    min_pad: int = 1,
+) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """(blob, meta, ref_meta) for `witness_verify_linked`: the blob/meta of
+    `pack_witness_blob` plus the (2, R) int32 (ref offset, ref block) rows of
+    every child hash reference (native scanner when available, Python
+    fallback otherwise). Pad rows carry offset -1. `min_pad` floors both
+    padded axes (power-of-two mesh divisibility)."""
+    from phant_tpu.utils.native import load_native
+
+    if pad_nodes_to is None and min_pad > 1:
+        total = sum(len(nodes) for nodes in node_lists)
+        pad_nodes_to = _pow2ceil(max(total, min_pad))
+    blob, meta = pack_witness_blob(node_lists, max_chunks, pad_nodes_to)
+    counts = [len(nodes) for nodes in node_lists]
+    B = sum(counts)
+    offsets = meta[0][:B].astype(np.uint64)
+    lens = meta[1][:B].astype(np.uint32)
+    native = load_native()
+    if native is not None:
+        ref_off, ref_node = native.scan_refs(blob, offsets, lens)
+    else:
+        ref_off, ref_node = scan_refs_py(blob, offsets, lens)
+    ref_block = meta[2][:B][ref_node]
+    R = len(ref_off)
+    target = pad_refs_to
+    if target is None:
+        target = _pow2ceil(max(R, min_pad))
+    if R > target:
+        raise ValueError(f"{R} refs exceed pad_refs_to={target}")
+    ref_meta = np.full((2, target), -1, np.int32)
+    ref_meta[0, :R] = ref_off.astype(np.int32)
+    ref_meta[1, :R] = ref_block
+    return blob, meta, ref_meta

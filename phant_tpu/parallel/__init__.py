@@ -5,6 +5,7 @@ from phant_tpu.parallel.mesh import (
     init_distributed,
     make_mesh,
     shard_map,
+    witness_verify_linked_sharded,
     witness_verify_sharded,
 )
 
@@ -13,5 +14,6 @@ __all__ = [
     "init_distributed",
     "make_mesh",
     "shard_map",
+    "witness_verify_linked_sharded",
     "witness_verify_sharded",
 ]

@@ -25,6 +25,8 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from phant_tpu.ops.witness_jax import (
     WITNESS_MAX_CHUNKS,
+    _gather_refs,
+    linked_verdict,
     partial_verdict,
     witness_digests,
 )
@@ -108,6 +110,59 @@ def witness_verify_sharded(
     meta_d = jax.device_put(jnp.asarray(meta), NamedSharding(mesh, P(None, mesh.axis_names[0])))
     roots_d = jax.device_put(jnp.asarray(roots), repl)
     return jax.jit(inner)(blob_d, meta_d, roots_d) > 0
+
+
+def witness_verify_linked_sharded(
+    mesh: Mesh,
+    blob,
+    meta,
+    ref_meta,
+    roots,
+    *,
+    max_chunks: int = WITNESS_MAX_CHUNKS,
+    n_blocks: Optional[int] = None,
+):
+    """Full (linked) multiproof verification with BOTH the node axis and the
+    ref axis sharded over `dp`. Each shard hashes its nodes and gathers its
+    slice of child refs from the replicated blob; the ref slices are then
+    `all_gather`-ed over the mesh (a small array — this is the collective
+    that rides ICI) because a node's parent may sit on any shard. Per-block
+    partials combine with pmax (root hit) / pmin (all nodes linked).
+
+    Node and ref axes must be divisible by the mesh size (pack_witness pads
+    both to powers of two).
+    """
+    if n_blocks is None:
+        n_blocks = int(roots.shape[0])
+    axis = mesh.axis_names[0]
+
+    @functools.partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(P(), P(None, axis), P(None, axis), P()),
+        out_specs=P(),
+    )
+    def inner(blob_s, meta_s, ref_s, roots_s):
+        offsets, lens, block_id = meta_s[0], meta_s[1], meta_s[2]
+        digests = witness_digests(blob_s, offsets, lens, max_chunks=max_chunks)
+        refs_local = _gather_refs(blob_s, ref_s[0])
+        refs = jax.lax.all_gather(refs_local, axis, axis=0, tiled=True)
+        ref_block = jax.lax.all_gather(ref_s[1], axis, axis=0, tiled=True)
+        ref_live = jax.lax.all_gather(ref_s[0] >= 0, axis, axis=0, tiled=True)
+        root_hit, all_ok = linked_verdict(
+            digests, lens, block_id, refs, ref_block, ref_live, roots_s, n_blocks
+        )
+        return jnp.stack([jax.lax.pmax(root_hit, axis), jax.lax.pmin(all_ok, axis)])
+
+    repl = NamedSharding(mesh, P())
+    col = NamedSharding(mesh, P(None, axis))
+    out = jax.jit(inner)(
+        jax.device_put(jnp.asarray(blob), repl),
+        jax.device_put(jnp.asarray(meta), col),
+        jax.device_put(jnp.asarray(ref_meta), col),
+        jax.device_put(jnp.asarray(roots), repl),
+    )
+    return (out[0] > 0) & (out[1] > 0)
 
 
 # ---------------------------------------------------------------------------

@@ -115,11 +115,11 @@ class TxSigner:
         call when `--crypto_backend=tpu`, else serially on CPU. Raises
         SignatureError if any signature is invalid — per-tx behavior matches
         `get_sender` exactly (differential-tested)."""
-        from phant_tpu.backend import crypto_backend
+        from phant_tpu.backend import crypto_backend, jax_device_ok
 
         if not txs:
             return []
-        use_tpu = crypto_backend() == "tpu"
+        use_tpu = crypto_backend() == "tpu" and jax_device_ok()
         native = None
         if not use_tpu:
             from phant_tpu.utils.native import load_native

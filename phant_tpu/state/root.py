@@ -13,7 +13,7 @@ from typing import Mapping
 
 from phant_tpu import rlp
 from phant_tpu.crypto.keccak import keccak256
-from phant_tpu.mpt.mpt import Trie
+from phant_tpu.mpt.mpt import Trie, trie_root_hash
 from phant_tpu.types.account import Account
 
 
@@ -24,7 +24,7 @@ def storage_root(storage: Mapping[int, int]) -> bytes:
             continue  # zero slots are absent from the trie
         key = keccak256(slot.to_bytes(32, "big"))
         trie.put(key, rlp.encode(rlp.encode_uint(value)))
-    return trie.root_hash()
+    return trie_root_hash(trie)
 
 
 def account_leaf(account: Account) -> bytes:
@@ -43,4 +43,4 @@ def state_root(accounts: Mapping[bytes, Account]) -> bytes:
         if account.is_empty() and not account.storage:
             continue
         trie.put(keccak256(address), account_leaf(account))
-    return trie.root_hash()
+    return trie_root_hash(trie)

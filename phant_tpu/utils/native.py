@@ -79,6 +79,16 @@ class NativeLib:
             ctypes.c_void_p,
         ]
         lib.phant_pack_keccak.restype = ctypes.c_int
+        lib.phant_scan_refs.argtypes = [
+            ctypes.c_void_p,
+            ctypes.c_void_p,
+            ctypes.c_void_p,
+            ctypes.c_size_t,
+            ctypes.c_void_p,
+            ctypes.c_void_p,
+            ctypes.c_size_t,
+        ]
+        lib.phant_scan_refs.restype = ctypes.c_long
         lib.phant_ecrecover.argtypes = [
             ctypes.c_char_p, ctypes.c_char_p, ctypes.c_char_p,
             ctypes.c_int32, ctypes.c_char_p,
@@ -159,6 +169,32 @@ class NativeLib:
         )
         raw, okb = addrs.raw, ok.raw
         return [raw[20 * i : 20 * i + 20] if okb[i] else None for i in range(n)]
+
+    def scan_refs(self, blob, offsets, lens):
+        """Child-ref scan over RLP trie nodes laid out in `blob` (numpy
+        arrays: offsets u64, lens u32). Returns (ref_off i64, ref_node i32)
+        numpy arrays, or raises ValueError on malformed RLP."""
+        import numpy as np
+
+        offsets = np.ascontiguousarray(offsets, np.uint64)
+        lens = np.ascontiguousarray(lens, np.uint32)
+        n = len(offsets)
+        cap = max(int(lens.sum()) // 33 + 17, 17)  # >= max possible refs
+        ref_off = np.empty(cap, np.int64)
+        ref_node = np.empty(cap, np.int32)
+        blob = np.ascontiguousarray(blob, dtype=np.uint8)
+        cnt = self._lib.phant_scan_refs(
+            blob.ctypes.data_as(ctypes.c_void_p),
+            offsets.ctypes.data_as(ctypes.c_void_p),
+            lens.ctypes.data_as(ctypes.c_void_p),
+            n,
+            ref_off.ctypes.data_as(ctypes.c_void_p),
+            ref_node.ctypes.data_as(ctypes.c_void_p),
+            cap,
+        )
+        if cnt < 0:
+            raise ValueError("malformed RLP in witness node")
+        return ref_off[:cnt], ref_node[:cnt]
 
     def keccak256_batch(self, payloads: Sequence[bytes]) -> List[bytes]:
         n = len(payloads)

@@ -16,6 +16,12 @@ import os
 if os.environ.get("PHANT_TEST_TPU", "0") in ("", "0"):
     os.environ["JAX_PLATFORMS"] = "cpu"
     os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+    # the device-path guard (phant_tpu/backend.py jax_device_ok) would
+    # otherwise re-route tpu-backend differential tests to the CPU path;
+    # here the CPU-mesh jax run IS the point
+    os.environ["PHANT_ALLOW_JAX_CPU"] = "1"
+    os.environ.setdefault("PHANT_TPU_MIN_TRIE", "1")  # small test tries must
+    # still exercise the device dispatch path
     flags = os.environ.get("XLA_FLAGS", "")
     if "xla_force_host_platform_device_count" not in flags:
         os.environ["XLA_FLAGS"] = (
@@ -34,15 +40,21 @@ if os.environ.get("PHANT_TEST_TPU", "0") in ("", "0"):
 import pytest
 
 
-@pytest.fixture(params=["python", "native"])
+@pytest.fixture(params=["python", "native", "tpu"])
 def evm_backend(request):
-    """Run a test on both EVM backends — the Python interpreter and the C++
-    core (the reference's evmone analog) must agree bit-for-bit."""
-    from phant_tpu.backend import set_evm_backend
+    """Run a test across backend combinations: "python"/"native" diff the two
+    EVM backends (the C++ core is the reference's evmone analog) on the cpu
+    crypto backend; "tpu" runs the native EVM with `--crypto_backend=tpu`
+    (batched jax ecrecover + device trie roots on the CPU mesh), so the whole
+    pipeline is differentially verified end-to-end (SURVEY §4)."""
+    from phant_tpu.backend import set_crypto_backend, set_evm_backend
     from phant_tpu.evm.native_vm import native_available
 
-    if request.param == "native" and not native_available():
+    param = request.param
+    if param in ("native", "tpu") and not native_available():
         pytest.skip("native toolchain unavailable")
-    set_evm_backend(request.param)
-    yield request.param
+    set_evm_backend("python" if param == "python" else "native")
+    set_crypto_backend("tpu" if param == "tpu" else "cpu")
+    yield param
     set_evm_backend("python")
+    set_crypto_backend("cpu")

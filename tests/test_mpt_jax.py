@@ -1,0 +1,130 @@
+"""Differential tests: device MPT root (phant_tpu/ops/mpt_jax.py) vs the
+host recursion (phant_tpu/mpt/mpt.py) — bit-exact on every trie shape,
+including the embedded-node fallback and the backend dispatch used by the
+block path (reference scope: src/mpt/mpt.zig:38-119)."""
+
+import numpy as np
+import pytest
+
+from phant_tpu import rlp
+from phant_tpu.backend import set_crypto_backend
+from phant_tpu.crypto.keccak import keccak256
+from phant_tpu.mpt.mpt import Trie, ordered_trie_root, trie_root_hash
+from phant_tpu.ops.mpt_jax import build_hash_plan, trie_root_device
+
+
+def _account_leaf(rng) -> bytes:
+    """~70B leaf value shaped like an account: keeps node encodings >= 32B."""
+    return rlp.encode(
+        [
+            rlp.encode_uint(int(rng.integers(0, 1000))),
+            rlp.encode_uint(int(rng.integers(0, 10**18))),
+            rng.bytes(32),
+            rng.bytes(32),
+        ]
+    )
+
+
+@pytest.mark.parametrize("n", [1, 2, 3, 5, 17, 100])
+def test_device_root_matches_host(n):
+    """Random secure-trie shapes, incl. non-power-of-two level populations
+    (regression: digest rows are pow2-padded per level; child references
+    must use padded positions)."""
+    rng = np.random.default_rng(n)
+    trie = Trie()
+    for _ in range(n):
+        trie.put(keccak256(rng.bytes(20)), _account_leaf(rng))
+    assert trie_root_device(trie) == trie.root_hash()
+
+
+def test_device_root_deep_extension():
+    """Keys sharing long prefixes force extension nodes and deep levels."""
+    rng = np.random.default_rng(42)
+    trie = Trie()
+    base = bytearray(keccak256(b"base"))
+    for i in range(8):
+        key = bytes(base[:-1]) + bytes([i * 16 + 7])
+        trie.put(key, _account_leaf(rng))
+    trie.put(keccak256(b"elsewhere"), _account_leaf(rng))
+    assert trie_root_device(trie) == trie.root_hash()
+
+
+def test_embedded_node_trie_falls_back():
+    """Small values produce <32B leaf encodings; the plan refuses and the
+    device path must return the host root."""
+    trie = Trie()
+    for i in range(4):
+        trie.put(bytes([i]) * 4, rlp.encode_uint(i + 1))
+    assert build_hash_plan(trie) is None
+    assert trie_root_device(trie) == trie.root_hash()
+
+
+def test_empty_and_single():
+    from phant_tpu.mpt.mpt import EMPTY_TRIE_ROOT
+
+    assert trie_root_device(Trie()) == EMPTY_TRIE_ROOT
+    rng = np.random.default_rng(0)
+    t = Trie()
+    t.put(keccak256(b"solo"), _account_leaf(rng))
+    assert trie_root_device(t) == t.root_hash()
+
+
+def test_branch_value_node():
+    """A key that is a strict prefix of another puts a value on a branch."""
+    rng = np.random.default_rng(9)
+    t = Trie()
+    long_key = keccak256(b"x")
+    t.put(long_key, _account_leaf(rng))
+    # shorter key = prefix of long_key's nibble path
+    t.put(long_key[:16], _account_leaf(rng))
+    assert trie_root_device(t) == t.root_hash()
+
+
+def test_backend_dispatch_ordered_root():
+    """ordered_trie_root must agree across crypto backends (the tx/receipt/
+    withdrawal roots the block path recomputes, reference:
+    src/blockchain/blockchain.zig:200-203)."""
+    rng = np.random.default_rng(3)
+    values = [rng.bytes(int(rng.integers(40, 200))) for _ in range(30)]
+    cpu = ordered_trie_root(values)
+    set_crypto_backend("tpu")
+    try:
+        tpu = ordered_trie_root(values)
+    finally:
+        set_crypto_backend("cpu")
+    assert cpu == tpu
+
+
+def test_backend_dispatch_state_root():
+    """state_root through the dispatcher (phant_tpu/state/root.py)."""
+    from phant_tpu.state.root import state_root
+    from phant_tpu.types.account import Account
+
+    rng = np.random.default_rng(5)
+    accounts = {}
+    for _ in range(20):
+        addr = rng.bytes(20)
+        accounts[addr] = Account(
+            nonce=int(rng.integers(0, 100)),
+            balance=int(rng.integers(0, 10**18)),
+            storage={int(rng.integers(0, 50)): int.from_bytes(rng.bytes(25), "big") + 1},
+        )
+    cpu = state_root(accounts)
+    set_crypto_backend("tpu")
+    try:
+        tpu = state_root(accounts)
+    finally:
+        set_crypto_backend("cpu")
+    assert cpu == tpu
+
+
+def test_trie_root_hash_dispatch():
+    rng = np.random.default_rng(11)
+    t = Trie()
+    for _ in range(12):
+        t.put(keccak256(rng.bytes(20)), _account_leaf(rng))
+    set_crypto_backend("tpu")
+    try:
+        assert trie_root_hash(t) == t.root_hash()
+    finally:
+        set_crypto_backend("cpu")

@@ -1,0 +1,320 @@
+"""Stateless execution from a witness (phant_tpu/stateless.py +
+engine_executeStatelessPayloadV1): execute blocks against ONLY proof nodes
+and codes, recompute the post-state root over the partial trie, and agree
+bit-for-bit with full-state execution. The reference lists the method but
+never implements it (reference: src/main.zig:24-54 vs main.zig:58-70)."""
+
+from __future__ import annotations
+
+import pytest
+
+from phant_tpu import rlp
+from phant_tpu.backend import set_crypto_backend
+from phant_tpu.blockchain.chain import Blockchain, calculate_base_fee
+from phant_tpu.crypto.keccak import keccak256
+from phant_tpu.engine_api import (
+    execute_stateless_payload_v1_handler,
+    handle_request,
+    payload_from_json,
+)
+from phant_tpu.mpt.mpt import EMPTY_TRIE_ROOT, Trie, ordered_trie_root
+from phant_tpu.mpt.proof import generate_proof
+from phant_tpu.signer.signer import TxSigner, address_from_pubkey
+from phant_tpu.state.root import account_leaf, state_root
+from phant_tpu.state.statedb import StateDB
+from phant_tpu.stateless import (
+    StatelessError,
+    WitnessStateDB,
+    execute_stateless,
+)
+from phant_tpu.types.account import Account
+from phant_tpu.types.block import Block, BlockHeader
+from phant_tpu.types.receipt import Receipt, logs_bloom
+from phant_tpu.types.transaction import LegacyTx
+from phant_tpu.utils.hexutils import bytes_to_hex
+from phant_tpu.crypto import secp256k1 as secp
+from phant_tpu.__main__ import make_genesis_parent_header
+
+CHAIN_ID = 1
+SENDER_KEY = 0xA1A1A1
+COINBASE = b"\xc0" * 20
+RECIPIENT = b"\x7e" * 20
+CONTRACT = b"\xcf" * 20
+# PUSH1 1 PUSH1 0 SSTORE STOP — writes slot 0 := 1
+CONTRACT_CODE = bytes.fromhex("600160005500")
+
+
+def _pre_accounts():
+    sender = address_from_pubkey(secp.pubkey_of(SENDER_KEY))
+    accounts = {
+        sender: Account(balance=10**20),
+        CONTRACT: Account(nonce=1, code=CONTRACT_CODE, storage={5: 7}),
+    }
+    # background accounts that stay unwitnessed (their subtrees must still
+    # contribute digests to the post root via HashNodes)
+    for i in range(1, 40):
+        accounts[bytes([i]) * 20] = Account(balance=i * 10**15)
+    return sender, accounts
+
+
+def _account_trie(accounts):
+    trie = Trie()
+    for addr, acct in accounts.items():
+        trie.put(keccak256(addr), account_leaf(acct))
+    return trie
+
+
+def _witness_for(accounts, addrs, storage_keys=()):
+    """Union of account proofs + storage proofs, exactly what a CL would
+    ship: nodes only, no addresses."""
+    trie = _account_trie(accounts)
+    nodes: dict = {}
+    for addr in addrs:
+        for enc in generate_proof(trie, keccak256(addr)):
+            nodes[enc] = None
+    for addr, slot in storage_keys:
+        strie = Trie()
+        for s, v in accounts[addr].storage.items():
+            strie.put(keccak256(s.to_bytes(32, "big")), rlp.encode(rlp.encode_uint(v)))
+        if strie.root is not None:
+            for enc in generate_proof(strie, keccak256(slot.to_bytes(32, "big"))):
+                nodes[enc] = None
+    return trie.root_hash(), list(nodes)
+
+
+def _build_block(accounts, txs):
+    """Assemble a consensus-valid block on the zero parent by executing the
+    txs on a full-state builder chain (the oracle for the stateless run)."""
+    parent = make_genesis_parent_header()
+    base_fee = calculate_base_fee(
+        parent.gas_limit, parent.gas_used, parent.base_fee_per_gas
+    )
+    full = StateDB({a: acct.copy() for a, acct in accounts.items()})
+    builder = Blockchain(CHAIN_ID, full, parent, verify_state_root=False)
+    draft_header = BlockHeader(
+        parent_hash=parent.hash(),
+        fee_recipient=COINBASE,
+        block_number=1,
+        gas_limit=parent.gas_limit,
+        timestamp=parent.timestamp + 12,
+        base_fee_per_gas=base_fee,
+        withdrawals_root=EMPTY_TRIE_ROOT,
+    )
+    draft = Block(header=draft_header, transactions=tuple(txs), withdrawals=())
+    result = builder.apply_body(draft)
+    post_root = full.state_root()
+    header = BlockHeader(
+        parent_hash=parent.hash(),
+        fee_recipient=COINBASE,
+        state_root=post_root,
+        transactions_root=ordered_trie_root([t.encode() for t in txs]),
+        receipts_root=ordered_trie_root([r.encode() for r in result.receipts]),
+        logs_bloom=result.logs_bloom,
+        block_number=1,
+        gas_limit=parent.gas_limit,
+        gas_used=result.gas_used,
+        timestamp=parent.timestamp + 12,
+        base_fee_per_gas=base_fee,
+        withdrawals_root=EMPTY_TRIE_ROOT,
+    )
+    block = Block(header=header, transactions=tuple(txs), withdrawals=())
+    return parent, block, post_root, full
+
+
+def _transfer_tx(base_fee_plus=100):
+    parent = make_genesis_parent_header()
+    base_fee = calculate_base_fee(
+        parent.gas_limit, parent.gas_used, parent.base_fee_per_gas
+    )
+    signer = TxSigner(CHAIN_ID)
+    tx = LegacyTx(
+        nonce=0,
+        gas_price=base_fee + base_fee_plus,  # tip so the coinbase isn't empty
+        gas_limit=100_000,
+        to=RECIPIENT,
+        value=12345,
+        data=b"",
+        v=37,
+        r=0,
+        s=0,
+    )
+    return signer.sign(tx, SENDER_KEY)
+
+
+def _contract_tx(nonce=0):
+    parent = make_genesis_parent_header()
+    base_fee = calculate_base_fee(
+        parent.gas_limit, parent.gas_used, parent.base_fee_per_gas
+    )
+    signer = TxSigner(CHAIN_ID)
+    tx = LegacyTx(
+        nonce=nonce,
+        gas_price=base_fee + 100,
+        gas_limit=100_000,
+        to=CONTRACT,
+        value=0,
+        data=b"",
+        v=37,
+        r=0,
+        s=0,
+    )
+    return signer.sign(tx, SENDER_KEY)
+
+
+def test_stateless_transfer_matches_full_state():
+    sender, accounts = _pre_accounts()
+    parent, block, post_root, _full = _build_block(accounts, [_transfer_tx()])
+    pre_root, nodes = _witness_for(accounts, [sender, RECIPIENT, COINBASE])
+    result, computed_root = execute_stateless(
+        CHAIN_ID, parent, block, pre_root, nodes, []
+    )
+    assert computed_root == post_root
+    assert result.gas_used == block.header.gas_used
+
+
+def test_stateless_contract_storage_write():
+    """SSTORE through the witness: storage slot materialization + storage
+    root recompute over the partial storage trie."""
+    sender, accounts = _pre_accounts()
+    parent, block, post_root, full = _build_block(accounts, [_contract_tx()])
+    assert full.get_storage(CONTRACT, 0) == 1  # sanity: the write happened
+    pre_root, nodes = _witness_for(
+        accounts,
+        [sender, CONTRACT, COINBASE],
+        storage_keys=[(CONTRACT, 0), (CONTRACT, 5)],
+    )
+    _result, computed_root = execute_stateless(
+        CHAIN_ID, parent, block, pre_root, nodes, [CONTRACT_CODE]
+    )
+    assert computed_root == post_root
+
+
+def test_stateless_missing_code_rejected():
+    sender, accounts = _pre_accounts()
+    parent, block, _post, _full = _build_block(accounts, [_contract_tx()])
+    pre_root, nodes = _witness_for(
+        accounts, [sender, CONTRACT, COINBASE], storage_keys=[(CONTRACT, 0), (CONTRACT, 5)]
+    )
+    with pytest.raises(StatelessError, match="missing code"):
+        execute_stateless(CHAIN_ID, parent, block, pre_root, nodes, [])
+
+
+def test_stateless_insufficient_witness_rejected():
+    """Omitting the recipient's proof path must fail loudly, not mis-root."""
+    sender, accounts = _pre_accounts()
+    parent, block, _post, _full = _build_block(accounts, [_transfer_tx()])
+    pre_root, nodes = _witness_for(accounts, [sender, COINBASE])
+    with pytest.raises((StatelessError, Exception)):
+        execute_stateless(CHAIN_ID, parent, block, pre_root, nodes, [])
+
+
+def test_stateless_broken_witness_rejected():
+    sender, accounts = _pre_accounts()
+    parent, block, _post, _full = _build_block(accounts, [_transfer_tx()])
+    pre_root, nodes = _witness_for(accounts, [sender, RECIPIENT, COINBASE])
+    # drop an inner node: linked verification must reject before execution
+    victim = max(range(len(nodes)), key=lambda i: len(nodes[i]))
+    bad = [n for i, n in enumerate(nodes) if i != victim]
+    with pytest.raises(StatelessError, match="witness rejected"):
+        execute_stateless(CHAIN_ID, parent, block, pre_root, bad, [])
+
+
+def test_stateless_wrong_poststate_root_rejected():
+    from phant_tpu.blockchain.chain import BlockError
+    from dataclasses import replace
+
+    sender, accounts = _pre_accounts()
+    parent, block, _post, _full = _build_block(accounts, [_transfer_tx()])
+    tampered = Block(
+        header=replace(block.header, state_root=b"\x13" * 32),
+        transactions=block.transactions,
+        withdrawals=block.withdrawals,
+    )
+    pre_root, nodes = _witness_for(accounts, [sender, RECIPIENT, COINBASE])
+    with pytest.raises(BlockError, match="state root"):
+        execute_stateless(CHAIN_ID, parent, tampered, pre_root, nodes, [])
+
+
+def test_stateless_device_witness_path():
+    """crypto_backend=tpu routes witness verification through the device
+    kernel (CPU mesh in tests) and must agree with the host path."""
+    sender, accounts = _pre_accounts()
+    parent, block, post_root, _full = _build_block(accounts, [_transfer_tx()])
+    pre_root, nodes = _witness_for(accounts, [sender, RECIPIENT, COINBASE])
+    set_crypto_backend("tpu")
+    try:
+        _result, computed_root = execute_stateless(
+            CHAIN_ID, parent, block, pre_root, nodes, []
+        )
+    finally:
+        set_crypto_backend("cpu")
+    assert computed_root == post_root
+
+
+# ---------------------------------------------------------------------------
+# Engine API handler round-trip (mirrors the newPayloadV2 round-trip test)
+
+
+def _payload_json(block):
+    h = block.header
+    return {
+        "parentHash": bytes_to_hex(h.parent_hash),
+        "feeRecipient": bytes_to_hex(h.fee_recipient),
+        "stateRoot": bytes_to_hex(h.state_root),
+        "receiptsRoot": bytes_to_hex(h.receipts_root),
+        "logsBloom": bytes_to_hex(h.logs_bloom),
+        "prevRandao": bytes_to_hex(h.mix_hash),
+        "blockNumber": hex(h.block_number),
+        "gasLimit": hex(h.gas_limit),
+        "gasUsed": hex(h.gas_used),
+        "timestamp": hex(h.timestamp),
+        "extraData": "0x",
+        "baseFeePerGas": hex(h.base_fee_per_gas),
+        "blockHash": bytes_to_hex(h.hash()),
+        "transactions": [bytes_to_hex(tx.encode()) for tx in block.transactions],
+        "withdrawals": [],
+    }
+
+
+def test_execute_stateless_payload_v1_handler_roundtrip():
+    sender, accounts = _pre_accounts()
+    parent, block, post_root, _full = _build_block(accounts, [_transfer_tx()])
+    pre_root, nodes = _witness_for(accounts, [sender, RECIPIENT, COINBASE])
+    chain = Blockchain(CHAIN_ID, StateDB(), parent, verify_state_root=False)
+    witness_json = {
+        "preStateRoot": bytes_to_hex(pre_root),
+        "state": [bytes_to_hex(n) for n in nodes],
+        "codes": [],
+    }
+    request = {
+        "jsonrpc": "2.0",
+        "id": 5,
+        "method": "engine_executeStatelessPayloadV1",
+        "params": [_payload_json(block), witness_json],
+    }
+    http_status, body = handle_request(chain, request)
+    assert http_status == 200
+    assert body["result"]["status"] == "VALID", body
+    assert body["result"]["stateRoot"] == bytes_to_hex(post_root)
+    # the node's own state is untouched — the run was stateless
+    assert chain.state.accounts == {}
+
+    # corrupted witness -> INVALID with a reason, never a wrong root
+    bad_witness = {**witness_json, "state": witness_json["state"][1:]}
+    _status, body2 = handle_request(
+        chain, {**request, "params": [_payload_json(block), bad_witness]}
+    )
+    assert body2["result"]["status"] == "INVALID"
+    assert body2["result"]["validationError"]
+
+
+def test_witness_statedb_lazy_reads():
+    sender, accounts = _pre_accounts()
+    pre_root, nodes = _witness_for(accounts, [sender, CONTRACT], [(CONTRACT, 5)])
+    w = WitnessStateDB(pre_root, nodes, [CONTRACT_CODE])
+    assert w.get_balance(sender) == 10**20
+    assert w.get_code(CONTRACT) == CONTRACT_CODE
+    assert w.get_storage(CONTRACT, 5) == 7
+    # unwitnessed account: loud failure, not a silent zero
+    with pytest.raises(StatelessError, match="does not cover"):
+        w.get_balance(b"\x01" * 20)

@@ -1,0 +1,180 @@
+"""Linked (full multiproof) witness verification: the device kernel
+(phant_tpu/ops/witness_jax.py witness_verify_linked), the host baseline
+(phant_tpu/mpt/proof.py verify_witness_linked), and the native/Python ref
+scanners must all agree — and all must reject witnesses whose parent->child
+hash chain is broken, not just ones whose root is absent."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from phant_tpu import rlp
+from phant_tpu.crypto.keccak import keccak256
+from phant_tpu.mpt.mpt import Trie
+from phant_tpu.mpt.proof import generate_proof, verify_witness_linked
+from phant_tpu.ops.witness_jax import (
+    WITNESS_MAX_CHUNKS,
+    pack_witness,
+    roots_to_words,
+    scan_refs_py,
+    witness_verify_linked,
+)
+
+
+def _build(trie_size=64, n_blocks=4, accounts=4, seed=7):
+    rng = np.random.default_rng(seed)
+    trie = Trie()
+    keys = []
+    for _ in range(trie_size):
+        key = keccak256(rng.bytes(20))
+        leaf = rlp.encode(
+            [
+                rlp.encode_uint(int(rng.integers(0, 1000))),
+                rlp.encode_uint(int(rng.integers(0, 10**18))),
+                rng.bytes(32),
+                rng.bytes(32),
+            ]
+        )
+        trie.put(key, leaf)
+        keys.append(key)
+    root = trie.root_hash()
+    witnesses = []
+    for _ in range(n_blocks):
+        idx = rng.choice(len(keys), size=accounts, replace=False)
+        nodes: dict = {}
+        for i in idx:
+            for enc in generate_proof(trie, keys[i]):
+                nodes[enc] = None
+        witnesses.append(list(nodes))
+    return root, witnesses
+
+
+def _device_verdicts(root, node_lists):
+    blob, meta, ref_meta = pack_witness(node_lists, WITNESS_MAX_CHUNKS)
+    roots = roots_to_words([root] * len(node_lists))
+    out = witness_verify_linked(
+        jnp.asarray(blob),
+        jnp.asarray(meta),
+        jnp.asarray(ref_meta),
+        jnp.asarray(roots),
+        max_chunks=WITNESS_MAX_CHUNKS,
+        n_blocks=len(node_lists),
+    )
+    return [bool(v) for v in np.asarray(out)]
+
+
+def test_valid_witnesses_verify_both_sides():
+    root, witnesses = _build()
+    assert all(verify_witness_linked(root, w) for w in witnesses)
+    assert _device_verdicts(root, witnesses) == [True] * len(witnesses)
+
+
+def _corruptions(witness):
+    """Broken variants of a valid witness (name, nodes)."""
+    from phant_tpu.mpt.proof import _child_refs
+
+    # drop a NON-ROOT inner node (one that hash-references another witness
+    # node): its children become unreachable. Dropping a leaf would still be
+    # a valid (smaller) witness, so it must be an inner node.
+    digests = {keccak256(n) for n in witness}
+    victim = next(
+        i
+        for i, n in enumerate(witness[1:], start=1)
+        if any(r in digests for r in _child_refs(rlp.decode(n)))
+    )
+    missing_inner = [n for i, n in enumerate(witness) if i != victim]
+    # flip a byte in a node body (its digest no longer matches its parent)
+    flipped = list(witness)
+    body = bytearray(flipped[-1])
+    body[len(body) // 2] ^= 0x40
+    flipped[-1] = bytes(body)
+    # inject a well-formed but foreign node (unlinked to this trie)
+    foreign = rlp.encode([bytes([0x20]) + b"\x11" * 8, b"\x77" * 40])
+    injected = list(witness) + [foreign]
+    return [
+        ("missing-inner-node", missing_inner),
+        ("flipped-byte", flipped),
+        ("injected-foreign-node", injected),
+    ]
+
+
+def test_corrupted_witness_rejected_host():
+    root, witnesses = _build(n_blocks=1, accounts=6)
+    for name, bad in _corruptions(witnesses[0]):
+        assert not verify_witness_linked(root, bad), name
+
+
+def test_corrupted_witness_rejected_device():
+    root, witnesses = _build(n_blocks=1, accounts=6)
+    for name, bad in _corruptions(witnesses[0]):
+        assert _device_verdicts(root, [bad]) == [False], name
+
+
+def test_mixed_batch_verdicts():
+    """Good and bad witnesses in one device batch get per-block verdicts."""
+    root, witnesses = _build(n_blocks=3, accounts=4)
+    _, bad = _corruptions(witnesses[1])[1]  # flipped byte
+    batch = [witnesses[0], bad, witnesses[2]]
+    assert _device_verdicts(root, batch) == [True, False, True]
+
+
+def test_missing_root_rejected():
+    root, witnesses = _build(n_blocks=1)
+    w = [n for n in witnesses[0] if keccak256(n) != root]
+    assert not verify_witness_linked(root, w)
+    assert _device_verdicts(root, [w]) == [False]
+
+
+def test_scanners_agree():
+    """Native C++ scanner vs pure-Python scanner, byte-for-byte."""
+    from phant_tpu.utils.native import load_native
+
+    native = load_native()
+    if native is None:
+        pytest.skip("native toolchain unavailable")
+    _root, witnesses = _build(n_blocks=2, accounts=8)
+    nodes = [n for w in witnesses for n in w]
+    blob = np.frombuffer(b"".join(nodes), np.uint8)
+    lens = np.asarray([len(n) for n in nodes], np.uint32)
+    offsets = np.zeros(len(nodes), np.uint64)
+    offsets[1:] = np.cumsum(lens[:-1])
+    n_off, n_node = native.scan_refs(blob, offsets, lens)
+    p_off, p_node = scan_refs_py(bytes(blob.tobytes()), offsets, lens)
+    assert n_off.tolist() == p_off.tolist()
+    assert n_node.tolist() == p_node.tolist()
+    assert len(n_off) > 0
+
+
+def test_scanner_embedded_and_leaf_values():
+    """Leaf/branch values must not count as refs; embedded children must."""
+    # leaf whose value is exactly 32 bytes: not a ref
+    leaf32 = rlp.encode([bytes([0x20]), b"\x01" * 32])
+    off, node = scan_refs_py(leaf32, np.asarray([0]), np.asarray([len(leaf32)]))
+    assert len(off) == 0
+    # extension -> 32B child: one ref
+    ext = rlp.encode([bytes([0x00, 0x12]), b"\x02" * 32])
+    off, _ = scan_refs_py(ext, np.asarray([0]), np.asarray([len(ext)]))
+    assert len(off) == 1
+    assert ext[int(off[0]) : int(off[0]) + 32] == b"\x02" * 32
+    # branch with two hash children + a 32B value: two refs
+    items = [b""] * 17
+    items[3] = b"\x03" * 32
+    items[9] = b"\x04" * 32
+    items[16] = b"\x05" * 32  # value, not a ref
+    branch = rlp.encode(items)
+    off, _ = scan_refs_py(branch, np.asarray([0]), np.asarray([len(branch)]))
+    assert len(off) == 2
+    # branch with an embedded leaf child carrying a 32B value: still no ref
+    emb = [bytes([0x35]), b"\x06" * 30]  # short embedded leaf (odd path, leaf flag)
+    items2 = [b""] * 17
+    items2[0] = emb
+    branch2 = rlp.encode(items2)
+    off, _ = scan_refs_py(branch2, np.asarray([0]), np.asarray([len(branch2)]))
+    assert len(off) == 0
+    # branch with an embedded EXTENSION child pointing at a hash: one ref
+    emb_ext = [bytes([0x11]), b"\x07" * 32]
+    items3 = [b""] * 17
+    items3[1] = emb_ext
+    branch3 = rlp.encode(items3)
+    off, _ = scan_refs_py(branch3, np.asarray([0]), np.asarray([len(branch3)]))
+    assert len(off) == 1
