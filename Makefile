@@ -1,0 +1,12 @@
+# Commit gate (VERDICT r2 #4): `make check` must be green before a snapshot.
+.PHONY: check check-fast native
+
+check:
+	./scripts/check.sh
+
+# Quick iteration subset (NOT a substitute for `make check` before commits).
+check-fast:
+	python -m pytest tests/ -q -x -k "not tpu"
+
+native:
+	python -c "from phant_tpu.utils.native import build_native; print(build_native(verbose=True))"

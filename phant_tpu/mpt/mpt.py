@@ -148,6 +148,93 @@ def _insert(node: Optional[Node], path: Tuple[int, ...], value: bytes) -> Node:
     return node
 
 
+# --- deletion (yellow-paper node collapse) ---------------------------------
+#
+# The reference is insert-only (reference: src/mpt/mpt.zig:47-119 has no
+# delete); deletion is required here because the stateless product path
+# must handle EIP-158 account cleanup, selfdestruct, and storage-zeroing —
+# all of which REMOVE keys and collapse branch/extension structure.
+
+
+class _Unresolved(Exception):
+    """Raised when a collapse needs the structure of an opaque child (only
+    possible on PartialTrie, where unwitnessed subtrees are HashNodes)."""
+
+
+def _merge_into(nibble_prefix: Tuple[int, ...], child: Node) -> Node:
+    """Prepend `nibble_prefix` to a child that lost its parent branch/ext."""
+    if isinstance(child, LeafNode):
+        return LeafNode(nibble_prefix + child.path, child.value)
+    if isinstance(child, ExtensionNode):
+        return ExtensionNode(nibble_prefix + child.path, child.child)
+    if isinstance(child, BranchNode):
+        if not nibble_prefix:
+            return child
+        return ExtensionNode(nibble_prefix, child)
+    # HashNode (PartialTrie): its kind is unknown, so the merged node's
+    # encoding cannot be computed — the witness is insufficient
+    raise _Unresolved()
+
+
+def _collapse_branch(node: BranchNode) -> Optional[Node]:
+    """Re-normalize a branch after a child was deleted."""
+    live = [(i, c) for i, c in enumerate(node.children) if c is not None]
+    if node.value is not None:
+        if not live:
+            return LeafNode((), node.value)
+        return node
+    if not live:
+        return None
+    if len(live) == 1:
+        i, child = live[0]
+        return _merge_into((i,), child)
+    return node
+
+
+def _delete(node: Optional[Node], path: Tuple[int, ...]) -> Optional[Node]:
+    """Remove `path`; returns the re-normalized subtree (None = empty).
+    Missing keys are a no-op (matching geth's trie delete semantics)."""
+    if node is None:
+        return None
+
+    if isinstance(node, LeafNode):
+        return None if node.path == tuple(path) else node
+
+    if not isinstance(node, (ExtensionNode, BranchNode)):
+        # opaque HashNode (PartialTrie): the delete path crosses an
+        # unwitnessed subtree
+        raise _Unresolved()
+
+    if isinstance(node, ExtensionNode):
+        n = len(node.path)
+        if tuple(path[:n]) != node.path:
+            return node  # key absent
+        new_child = _delete(node.child, tuple(path[n:]))
+        if new_child is node.child:
+            return node  # absent below: no structural change
+        if new_child is None:
+            return None
+        return _merge_into(node.path, new_child)
+
+    # BranchNode
+    if not path:
+        if node.value is None:
+            return node  # key absent
+        node.value = None
+        return _collapse_branch(node)
+    i = path[0]
+    old_child = node.children[i]
+    if old_child is None:
+        return node  # key absent
+    new_child = _delete(old_child, tuple(path[1:]))
+    if new_child is old_child:
+        return node  # no structural change
+    node.children[i] = new_child
+    if new_child is not None:
+        return node
+    return _collapse_branch(node)
+
+
 class Trie:
     """A build-once/query MPT over byte keys."""
 
@@ -161,11 +248,19 @@ class Trie:
         self._enc_cache: Dict[int, Tuple[rlp.RLPItem, bytes]] = {}
 
     def put(self, key: bytes, value: bytes) -> None:
-        if not value:
-            raise ValueError("MPT deletion (empty value) not supported in builder")
+        if not value:  # empty value = delete (geth trie semantics)
+            self.delete(key)
+            return
         self._enc_cache.clear()
         self.approx_size += 1
         self.root = _insert(self.root, bytes_to_nibbles(key), value)
+
+    def delete(self, key: bytes) -> None:
+        """Remove `key` with full branch-collapse/extension-merge
+        re-normalization (no-op when absent)."""
+        self._enc_cache.clear()
+        self.approx_size = max(self.approx_size - 1, 0)
+        self.root = _delete(self.root, bytes_to_nibbles(key))
 
     def get(self, key: bytes) -> Optional[bytes]:
         node, path = self.root, bytes_to_nibbles(key)

@@ -21,10 +21,13 @@ Pieces:
   key = keccak(slot_be32)), and whose `state_root()` recomputes the post
   root by writing every dirty account back into the partial trie.
 
-Limitation (documented): account deletion (EIP-158 cleanup of touched-empty
-accounts) requires MPT node collapse on the partial trie, which is not yet
-implemented — such blocks raise StatelessError and the handler reports
-INVALID with a clear validation_error rather than a wrong root.
+Deletion is fully supported: EIP-158 cleanup of touched-empty accounts,
+selfdestruct, and storage-zeroing delete keys from the partial trie with
+full branch-collapse/extension-merge re-normalization (phant_tpu/mpt/mpt.py
+_delete). The one witness-shaped limit is inherent to stateless execution:
+collapsing a branch down to a single unwitnessed (HashNode) sibling needs
+that sibling's encoding, so such a witness raises StatelessError — witness
+formats must include deletion siblings, as real stateless protocols do.
 """
 
 from __future__ import annotations
@@ -137,12 +140,32 @@ class PartialTrie(Trie):
     # --- writes -----------------------------------------------------------
 
     def put(self, key: bytes, value: bytes) -> None:
-        if not value:
-            raise StatelessError(
-                "MPT deletion on a partial trie is not supported"
-            )
+        if not value:  # empty value = delete (geth trie semantics)
+            self.delete(key)
+            return
         self._enc_cache.clear()
         self.root = _insert_partial(self.root, bytes_to_nibbles(key), value)
+
+    def delete(self, key: bytes) -> None:
+        """Remove `key` with full node collapse. Raises StatelessError when
+        the collapse needs the structure of an unwitnessed sibling (a branch
+        left with one HashNode child must merge the nibble into it, which
+        requires its encoding) — the witness is insufficient, exactly the
+        case stateless witness formats require sibling nodes for."""
+        from phant_tpu.mpt.mpt import _delete, _Unresolved
+
+        self._enc_cache.clear()
+        try:
+            self.root = _delete(self.root, bytes_to_nibbles(key))
+        except _Unresolved:
+            # _delete mutates in place on the way down, so the trie is now
+            # half-deleted (key gone, collapse pending) — poison it so no
+            # caller can hash the non-canonical structure
+            self._broken = True
+            raise StatelessError(
+                "deletion collapse crosses an unwitnessed subtree "
+                f"(key {key.hex()}); the witness must include sibling nodes"
+            ) from None
 
     # --- hashing ----------------------------------------------------------
 
@@ -156,7 +179,14 @@ class PartialTrie(Trie):
             raise StatelessError("cannot encode an unwitnessed subtree")
         return super().node_encoding(node)
 
+    _broken = False  # set by a failed delete(); the structure is no longer
+    # canonical and must never be hashed
+
     def root_hash(self) -> bytes:
+        if self._broken:
+            raise StatelessError(
+                "partial trie is poisoned by a failed deletion collapse"
+            )
         if isinstance(self.root, HashNode):
             return self.root.digest
         return super().root_hash()
@@ -239,7 +269,16 @@ class WitnessStateDB(StateDB):
         self._seen: set = set()
         self._storage_roots: Dict[bytes, bytes] = {}
         self._storage_tries: Dict[bytes, PartialTrie] = {}
-        self._slots_seen: set = set()
+        self._slots_seen: Dict[bytes, set] = {}  # addr -> slots
+        # materialized pre-values, for write-back dirtiness checks: only
+        # slots/accounts that actually changed touch the trie at root time
+        self._pre_slots: Dict[Tuple[bytes, int], int] = {}
+        self._pre_accounts: Dict[bytes, Tuple[int, int, bytes]] = {}
+        # the materialized Account object per address: identity change means
+        # delete+recreate within the block (journal rollback restores the
+        # original object, so identity is a reliable generation marker) —
+        # a recreated account starts from an EMPTY storage trie
+        self._mat_objs: Dict[bytes, object] = {}
 
     # --- materialization ---------------------------------------------------
 
@@ -267,18 +306,25 @@ class WitnessStateDB(StateDB):
                 )
         # pre-state materialization is not journaled: a block rollback must
         # not forget what the witness proved
-        self.accounts[addr] = Account(nonce=nonce, balance=balance, code=code)
+        acct = Account(nonce=nonce, balance=balance, code=code)
+        self.accounts[addr] = acct
         self._storage_roots[addr] = storage_root
+        self._pre_accounts[addr] = (nonce, balance, code_hash)
+        self._mat_objs[addr] = acct
 
     def _materialize_slot(self, addr: bytes, slot: int) -> None:
         key = (addr, slot)
-        if key in self._slots_seen:
+        seen = self._slots_seen.setdefault(addr, set())
+        if slot in seen:
             return
-        self._slots_seen.add(key)
+        seen.add(slot)
         self._materialize(addr)
         acct = self.accounts.get(addr)
         if acct is None:
             return
+        if self._mat_objs.get(addr) is not acct:
+            return  # recreated after deletion: storage starts empty, the
+            # witnessed pre-state slot must NOT leak into the new generation
         sroot = self._storage_roots.get(addr, EMPTY_TRIE_ROOT)
         if sroot == EMPTY_TRIE_ROOT:
             return
@@ -288,7 +334,9 @@ class WitnessStateDB(StateDB):
             self._storage_tries[addr] = strie
         raw = strie.get(keccak256(slot.to_bytes(32, "big")))
         if raw is not None:
-            acct.storage[slot] = rlp.decode_uint(bytes(rlp.decode(raw)))
+            value = rlp.decode_uint(bytes(rlp.decode(raw)))
+            acct.storage[slot] = value
+            self._pre_slots[key] = value
 
     # --- overridden accessors ---------------------------------------------
 
@@ -320,6 +368,13 @@ class WitnessStateDB(StateDB):
         self._materialize(addr)
         return super().is_empty(addr)
 
+    def touch(self, addr):
+        # EIP-158 cleanup (destroy_touched_empty) inspects accounts directly;
+        # a touched pre-existing empty account must be materialized or its
+        # leaf would silently survive deletion
+        self._materialize(addr)
+        super().touch(addr)
+
     def get_storage(self, addr, slot):
         self._materialize_slot(addr, slot)
         return super().get_storage(addr, slot)
@@ -328,31 +383,31 @@ class WitnessStateDB(StateDB):
         self._materialize_slot(addr, slot)
         return super().set_storage(addr, slot, value)
 
-    def delete_account(self, addr):
-        if addr in self.accounts:
-            raise StatelessError(
-                "account deletion on a partial trie is not supported"
-            )
-        super().delete_account(addr)
-
     # --- post root ----------------------------------------------------------
 
     def state_root(self) -> bytes:
         """Post-state root over the witnessed subtree: write every account
-        this execution materialized or created back into the partial trie
-        (untouched subtrees contribute their witnessed digests), recomputing
-        storage roots for accounts whose slots changed."""
-        from phant_tpu.state.root import account_leaf
-
+        this execution changed back into the partial trie (untouched
+        subtrees contribute their witnessed digests; unchanged materialized
+        accounts are skipped — dirtiness check), recomputing storage roots
+        for accounts whose slots changed. Deleted accounts (EIP-158 cleanup,
+        selfdestruct) are removed with full node collapse."""
         for addr in sorted(self._seen | set(self.accounts)):
             acct = self.accounts.get(addr)
+            key = keccak256(addr)
             if acct is None:
-                if addr in self._seen and self._trie.get(keccak256(addr)) is not None:
-                    raise StatelessError(
-                        "account deletion on a partial trie is not supported"
-                    )
+                if addr in self._pre_accounts:  # existed pre-state: delete
+                    self._trie.delete(key)
                 continue
             sroot = self._storage_root_of(addr, acct)
+            pre = self._pre_accounts.get(addr)
+            if (
+                pre is not None
+                and self._mat_objs.get(addr) is acct
+                and pre == (acct.nonce, acct.balance, acct.code_hash())
+                and sroot == self._storage_roots.get(addr, EMPTY_TRIE_ROOT)
+            ):
+                continue  # account unchanged: leave its witnessed leaf alone
             leaf = rlp.encode(
                 [
                     rlp.encode_uint(acct.nonce),
@@ -361,28 +416,35 @@ class WitnessStateDB(StateDB):
                     acct.code_hash(),
                 ]
             )
-            self._trie.put(keccak256(addr), leaf)
+            self._trie.put(key, leaf)
         return self._trie.root_hash()
 
     def _storage_root_of(self, addr: bytes, acct: Account) -> bytes:
-        pre_root = self._storage_roots.get(addr, EMPTY_TRIE_ROOT)
-        dirty = {s for (a, s) in self._slots_seen if a == addr}
-        if not any(True for _ in dirty):
+        fresh = self._mat_objs.get(addr) is not acct  # created (or recreated
+        # after selfdestruct) this block: storage starts from the empty trie
+        pre_root = (
+            EMPTY_TRIE_ROOT if fresh else self._storage_roots.get(addr, EMPTY_TRIE_ROOT)
+        )
+        dirty = set(self._slots_seen.get(addr, ()))
+        dirty |= set(acct.storage)
+        changed = {
+            s for s in dirty
+            if acct.storage.get(s, 0)
+            != (0 if fresh else self._pre_slots.get((addr, s), 0))
+        }
+        if not changed:
             return pre_root
-        strie = self._storage_tries.get(addr)
+        strie = self._storage_tries.get(addr) if not fresh else None
         if strie is None:
             strie = PartialTrie(pre_root, self._db)
             self._storage_tries[addr] = strie
-        for slot in sorted(dirty):
+        for slot in sorted(changed):
             value = acct.storage.get(slot, 0)
             key = keccak256(slot.to_bytes(32, "big"))
             if value == 0:
-                if strie.get(key) is not None:
-                    raise StatelessError(
-                        "storage deletion on a partial trie is not supported"
-                    )
-                continue
-            strie.put(key, rlp.encode(rlp.encode_uint(value)))
+                strie.delete(key)  # storage-zeroing: delete with collapse
+            else:
+                strie.put(key, rlp.encode(rlp.encode_uint(value)))
         return strie.root_hash()
 
     def copy(self):  # pragma: no cover — stateless runs are one-shot

@@ -1,0 +1,7 @@
+#!/usr/bin/env bash
+# Commit gate: the FULL test suite must be green before any snapshot commit.
+# (VERDICT r1 #3 / r2 weak #1: two consecutive rounds shipped a red suite.)
+# Usage: scripts/check.sh [extra pytest args]
+set -euo pipefail
+cd "$(dirname "$0")/.."
+python -m pytest tests/ -q "$@"

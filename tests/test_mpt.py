@@ -169,3 +169,103 @@ def test_witness_multi_key():
     assert verify_witness(root, entries, nodes)
     wrong = [(keys[0], b"not the value")] + entries[1:]
     assert not verify_witness(root, wrong, nodes)
+
+
+# --- deletion + node collapse (round-3: EIP-158/selfdestruct/storage-zeroing
+# need real delete semantics; the reference is insert-only, mpt.zig:47-119) --
+
+
+def _rebuild_root(d: dict) -> bytes:
+    t = Trie()
+    for k, v in d.items():
+        t.put(k, v)
+    return t.root_hash()
+
+
+def test_delete_to_empty():
+    t = Trie()
+    t.put(b"k", b"v")
+    t.delete(b"k")
+    assert t.root_hash() == EMPTY_TRIE_ROOT
+    t.delete(b"missing")  # no-op on empty
+    assert t.root_hash() == EMPTY_TRIE_ROOT
+
+
+def test_delete_missing_key_is_noop():
+    t = Trie()
+    t.put(b"abc", b"1")
+    t.put(b"abd", b"2")
+    before = t.root_hash()
+    t.delete(b"zzz")
+    t.delete(b"ab")  # prefix of existing keys, not itself present
+    assert t.root_hash() == before
+
+
+def test_delete_collapses_branch_to_leaf():
+    # two keys diverge at the last nibble -> branch; deleting one must fold
+    # the branch back into a single leaf identical to a fresh insert
+    t = Trie()
+    t.put(b"a1", b"one")
+    t.put(b"a2", b"two")
+    t.delete(b"a2")
+    assert t.root_hash() == _rebuild_root({b"a1": b"one"})
+
+
+def test_delete_merges_extension_chain():
+    # shared prefix -> extension + branch; removing one side must merge the
+    # extension with the surviving subtree
+    d = {b"abcdef01": b"x", b"abcdef02": b"y", b"abcdXYZ9": b"z"}
+    t = Trie()
+    for k, v in d.items():
+        t.put(k, v)
+    t.delete(b"abcdXYZ9")
+    del d[b"abcdXYZ9"]
+    assert t.root_hash() == _rebuild_root(d)
+    t.delete(b"abcdef01")
+    del d[b"abcdef01"]
+    assert t.root_hash() == _rebuild_root(d)
+
+
+def test_delete_branch_value_only():
+    # a key that terminates AT a branch (its value slot), plus two children
+    t = Trie()
+    keys = {bytes([0x12]): b"at-branch", bytes([0x12, 0x30]): b"c1", bytes([0x12, 0x45]): b"c2"}
+    for k, v in keys.items():
+        t.put(k, v)
+    t.delete(bytes([0x12]))
+    del keys[bytes([0x12])]
+    assert t.root_hash() == _rebuild_root(keys)
+    # now deleting one child folds the branch away entirely
+    t.delete(bytes([0x12, 0x45]))
+    del keys[bytes([0x12, 0x45])]
+    assert t.root_hash() == _rebuild_root(keys)
+
+
+def test_put_empty_value_deletes():
+    t = Trie()
+    t.put(b"k1", b"v1")
+    t.put(b"k2", b"v2")
+    t.put(b"k2", b"")
+    assert t.root_hash() == _rebuild_root({b"k1": b"v1"})
+
+
+def test_delete_fuzz_against_rebuild():
+    rng = random.Random(42)
+    d: dict = {}
+    t = Trie()
+    for step in range(600):
+        if d and rng.random() < 0.45:
+            k = rng.choice(list(d))
+            t.delete(k)
+            del d[k]
+        else:
+            k = rng.randbytes(rng.choice([1, 2, 3, 8, 20, 32]))
+            v = rng.randbytes(rng.randint(1, 40))
+            t.put(k, v)
+            d[k] = v
+        if step % 60 == 0:
+            assert t.root_hash() == _rebuild_root(d), f"divergence at step {step}"
+    assert t.root_hash() == _rebuild_root(d)
+    for k in list(d):
+        t.delete(k)
+    assert t.root_hash() == EMPTY_TRIE_ROOT

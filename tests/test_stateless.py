@@ -318,3 +318,145 @@ def test_witness_statedb_lazy_reads():
     # unwitnessed account: loud failure, not a silent zero
     with pytest.raises(StatelessError, match="does not cover"):
         w.get_balance(b"\x01" * 20)
+
+
+# --- deletion through the witness (round 3: MPT delete + node collapse) ----
+
+# PUSH1 0 PUSH1 5 SSTORE STOP — zeroes slot 5 (pre-state has {5: 7})
+ZERO_SLOT_CODE = bytes.fromhex("600060055500")
+# PUSH20 RECIPIENT SELFDESTRUCT
+SELFDESTRUCT_CODE = bytes.fromhex("73" + "7e" * 20 + "ff")
+EMPTY_ACCT = b"\xee" * 20
+
+
+def _full_witness(accounts, storage_addrs=()):
+    """Proofs for EVERY account (and every slot of `storage_addrs`): enough
+    nodes that any deletion collapse can resolve its siblings."""
+    return _witness_for(
+        accounts,
+        list(accounts),
+        [(a, s) for a in storage_addrs for s in accounts[a].storage],
+    )
+
+
+def test_stateless_storage_zeroing():
+    """SSTORE(5, 0) deletes the slot from the partial storage trie (with
+    collapse) and the post root matches full-state execution."""
+    sender, accounts = _pre_accounts()
+    accounts[CONTRACT] = Account(nonce=1, code=ZERO_SLOT_CODE, storage={5: 7})
+    parent, block, post_root, full = _build_block(accounts, [_contract_tx()])
+    assert full.get_storage(CONTRACT, 5) == 0  # sanity: the zeroing happened
+    pre_root, nodes = _full_witness(accounts, storage_addrs=[CONTRACT])
+    _result, computed_root = execute_stateless(
+        CHAIN_ID, parent, block, pre_root, nodes, [ZERO_SLOT_CODE]
+    )
+    assert computed_root == post_root
+
+
+def test_stateless_selfdestruct():
+    """SELFDESTRUCT removes the whole account leaf from the partial trie."""
+    sender, accounts = _pre_accounts()
+    accounts[CONTRACT] = Account(nonce=1, code=SELFDESTRUCT_CODE, storage={5: 7})
+    parent, block, post_root, full = _build_block(accounts, [_contract_tx()])
+    assert full.get_account(CONTRACT) is None  # sanity: destroyed
+    pre_root, nodes = _full_witness(accounts, storage_addrs=[CONTRACT])
+    _result, computed_root = execute_stateless(
+        CHAIN_ID, parent, block, pre_root, nodes, [SELFDESTRUCT_CODE]
+    )
+    assert computed_root == post_root
+
+
+def test_stateless_eip158_touched_empty_cleanup():
+    """A zero-value transfer touching a pre-existing empty account deletes
+    its leaf (EIP-158) during stateless execution."""
+    sender, accounts = _pre_accounts()
+    accounts[EMPTY_ACCT] = Account()  # empty: nonce 0, balance 0, no code
+    signer = TxSigner(CHAIN_ID)
+    parent0 = make_genesis_parent_header()
+    base_fee = calculate_base_fee(
+        parent0.gas_limit, parent0.gas_used, parent0.base_fee_per_gas
+    )
+    tx = signer.sign(
+        LegacyTx(nonce=0, gas_price=base_fee + 100, gas_limit=100_000,
+                 to=EMPTY_ACCT, value=0, data=b"", v=37, r=0, s=0),
+        SENDER_KEY,
+    )
+    parent, block, post_root, full = _build_block(accounts, [tx])
+    assert full.get_account(EMPTY_ACCT) is None  # sanity: EIP-158 fired
+    pre_root, nodes = _full_witness(accounts)
+    _result, computed_root = execute_stateless(
+        CHAIN_ID, parent, block, pre_root, nodes, []
+    )
+    assert computed_root == post_root
+
+
+def test_partial_trie_delete_needs_sibling():
+    """Collapsing a branch to one UNWITNESSED child must raise (the merged
+    node's encoding depends on the sibling's structure)."""
+    from phant_tpu.stateless import PartialTrie
+
+    t = Trie()
+    key_a, key_b = bytes([0x10]), bytes([0x20])
+    t.put(key_a, b"A" * 40)  # >=32B values force hash references
+    t.put(key_b, b"B" * 40)
+    root = t.root_hash()
+    enc_root = t.node_encoding(t.root)[1]
+    enc_a = t.node_encoding(t.root.children[1])[1]
+    enc_b = t.node_encoding(t.root.children[2])[1]
+
+    # sibling B witnessed: delete works and matches the rebuilt root
+    pt = PartialTrie(keccak256(enc_root), {
+        keccak256(enc_root): enc_root,
+        keccak256(enc_a): enc_a,
+        keccak256(enc_b): enc_b,
+    })
+    assert pt.root_hash() == root
+    pt.delete(key_a)
+    solo = Trie()
+    solo.put(key_b, b"B" * 40)
+    assert pt.root_hash() == solo.root_hash()
+
+    # sibling B opaque: the collapse cannot be computed
+    pt2 = PartialTrie(keccak256(enc_root), {
+        keccak256(enc_root): enc_root,
+        keccak256(enc_a): enc_a,
+    })
+    with pytest.raises(StatelessError, match="sibling"):
+        pt2.delete(key_a)
+
+
+def test_witness_statedb_recreate_does_not_leak_storage():
+    """After delete_account + recreation at the same address, pre-state
+    storage must NOT materialize into the new generation (code-review r3
+    finding: SLOAD on a CREATE2-redeployed contract must read 0)."""
+    sender, accounts = _pre_accounts()
+    pre_root, nodes = _full_witness(accounts, storage_addrs=[CONTRACT])
+    db = WitnessStateDB(pre_root, nodes, [CONTRACT_CODE])
+    assert db.get_storage(CONTRACT, 5) == 7  # witnessed pre-state
+    db.delete_account(CONTRACT)
+    db.create_account(CONTRACT)
+    assert db.get_storage(CONTRACT, 5) == 0  # fresh generation reads empty
+
+
+def test_stateless_eip158_zero_tip_coinbase_cleanup():
+    """A pre-existing EMPTY coinbase touched with zero priority fee must be
+    EIP-158-deleted in stateless execution too (touch materializes)."""
+    sender, accounts = _pre_accounts()
+    accounts[COINBASE] = Account()  # empty pre-existing coinbase leaf
+    parent0 = make_genesis_parent_header()
+    base_fee = calculate_base_fee(
+        parent0.gas_limit, parent0.gas_used, parent0.base_fee_per_gas
+    )
+    signer = TxSigner(CHAIN_ID)
+    tx = signer.sign(
+        LegacyTx(nonce=0, gas_price=base_fee, gas_limit=100_000,  # tip = 0
+                 to=RECIPIENT, value=5, data=b"", v=37, r=0, s=0),
+        SENDER_KEY,
+    )
+    parent, block, post_root, full = _build_block(accounts, [tx])
+    assert full.get_account(COINBASE) is None  # sanity: EIP-158 fired
+    pre_root, nodes = _full_witness(accounts)
+    _result, computed_root = execute_stateless(
+        CHAIN_ID, parent, block, pre_root, nodes, []
+    )
+    assert computed_root == post_root
