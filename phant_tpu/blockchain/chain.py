@@ -12,6 +12,7 @@ reference: blockchain.zig:83-88).
 
 from __future__ import annotations
 
+import os
 from dataclasses import dataclass
 from typing import List, Optional, Tuple
 
@@ -76,32 +77,103 @@ class Blockchain:
 
     # ------------------------------------------------------------------
 
-    def run_block(self, block: Block, check_body_roots: bool = True) -> BlockExecutionResult:
+    def run_block(
+        self,
+        block: Block,
+        check_body_roots: bool = True,
+        senders: Optional[List[Optional[bytes]]] = None,
+    ) -> BlockExecutionResult:
         """Validate + execute + verify roots (reference: blockchain.zig:61-96).
 
         An invalid block leaves no trace: execution is journaled and rolled
         back on any failure. `check_body_roots=False` skips re-deriving the
         tx/withdrawal roots — used by the Engine API path, whose `to_block`
         derived exactly those roots from the same tx/withdrawal tuples one
-        call earlier (the blockHash check covers header integrity there)."""
+        call earlier (the blockHash check covers header integrity there).
+        `senders` optionally supplies prefetched sender addresses (None
+        entries = invalid signature) from the run_blocks pipeline."""
         self.validate_block_header(block.header)
         if block.uncles:
             raise BlockError("post-merge blocks must have no uncles")
 
         self.state.begin_block()
         try:
-            return self._execute_block(block, check_body_roots)
+            return self._execute_block(block, check_body_roots, senders)
         except BaseException:
             self.state.rollback_block()
             raise
 
-    def _execute_block(self, block: Block, check_body_roots: bool) -> BlockExecutionResult:
+    def run_blocks(
+        self, blocks: List[Block], check_body_roots: bool = True
+    ) -> List[BlockExecutionResult]:
+        """Sequential block import with pipelined sender recovery: on
+        `--crypto_backend=tpu`, whole windows of upcoming blocks' signatures
+        are dispatched to the device ecrecover kernel while earlier blocks
+        execute on the CPU — the device computes under the EVM's feet and
+        per-dispatch latency is amortized over hundreds of txs. The
+        reference's import loop is strictly serial per tx
+        (reference: src/blockchain/blockchain.zig:61-96, :241); the batching
+        axis across blocks is this framework's north-star addition."""
+        from phant_tpu.backend import crypto_backend, jax_device_ok
+
+        results = []
+        if not (crypto_backend() == "tpu" and jax_device_ok()):
+            for block in blocks:
+                results.append(self.run_block(block, check_body_roots))
+            return results
+
+        window = int(os.environ.get("PHANT_TPU_PREFETCH_SIGS", "2048"))
+        # split blocks into windows of >= `window` signatures; dispatch each
+        # window's recovery in ONE fused device call, two windows in flight
+        spans: List[Tuple[int, int]] = []  # [start_block, end_block)
+        start, count = 0, 0
+        for i, b in enumerate(blocks):
+            count += len(b.transactions)
+            if count >= window:
+                spans.append((start, i + 1))
+                start, count = i + 1, 0
+        if start < len(blocks):
+            spans.append((start, len(blocks)))
+
+        def dispatch(span):
+            s, e = span
+            txs = [tx for b in blocks[s:e] for tx in b.transactions]
+            return self.signer.recover_senders_async(txs)
+
+        pending: List = []
+        next_span = 0
+        for k in range(min(2, len(spans))):
+            pending.append(dispatch(spans[k]))
+            next_span += 1
+
+        for si, (s, e) in enumerate(spans):
+            senders_flat = pending.pop(0)()
+            if next_span < len(spans):  # keep the device one window ahead
+                pending.append(dispatch(spans[next_span]))
+                next_span += 1
+            pos = 0
+            for block in blocks[s:e]:
+                n = len(block.transactions)
+                results.append(
+                    self.run_block(
+                        block, check_body_roots, senders=senders_flat[pos : pos + n]
+                    )
+                )
+                pos += n
+        return results
+
+    def _execute_block(
+        self,
+        block: Block,
+        check_body_roots: bool,
+        senders: Optional[List[Optional[bytes]]] = None,
+    ) -> BlockExecutionResult:
         # record parent hash for BLOCKHASH (reference: blockchain.zig:71)
         self.fork.update_parent_block_hash(
             self.parent_header.block_number, self.parent_header.hash()
         )
 
-        result = self.apply_body(block)
+        result = self.apply_body(block, senders)
 
         header = block.header
         if result.gas_used != header.gas_used:
@@ -170,7 +242,9 @@ class Blockchain:
 
     # ------------------------------------------------------------------
 
-    def apply_body(self, block: Block) -> BlockExecutionResult:
+    def apply_body(
+        self, block: Block, senders: Optional[List[Optional[bytes]]] = None
+    ) -> BlockExecutionResult:
         """(reference: blockchain.zig:155-205)"""
         header = block.header
         gas_available = header.gas_limit
@@ -178,13 +252,23 @@ class Blockchain:
         cumulative_gas = 0
         all_logs = []
 
-        # recover every sender up front — one fused device call on the tpu
-        # crypto backend, serial CPU otherwise (reference recovers per-tx,
-        # blockchain.zig:241)
-        try:
-            senders = self.signer.get_senders_batch(list(block.transactions))
-        except SignatureError as e:
-            raise BlockError(f"invalid signature: {e}") from e
+        # recover every sender up front — one fused batch (native, or device
+        # when the tpu backend and batch size warrant it; reference recovers
+        # per-tx, blockchain.zig:241). run_blocks may hand in prefetched
+        # senders recovered on device while earlier blocks executed.
+        if senders is None:
+            try:
+                senders = self.signer.get_senders_batch(list(block.transactions))
+            except SignatureError as e:
+                raise BlockError(f"invalid signature: {e}") from e
+        else:
+            if len(senders) != len(block.transactions):
+                raise BlockError("prefetched sender count mismatch")
+            bad = [i for i, a in enumerate(senders) if a is None]
+            if bad:
+                raise BlockError(
+                    f"invalid signature: unrecoverable signature at tx index {bad[0]}"
+                )
 
         for tx, sender in zip(block.transactions, senders):
             self.check_transaction(tx, header, gas_available, sender)

@@ -246,12 +246,16 @@ class Trie:
         # node-id -> (structure, encoding) memo; valid only between mutations
         # (cleared on put; ids are stable while the trie is read-only).
         self._enc_cache: Dict[int, Tuple[rlp.RLPItem, bytes]] = {}
+        # mutation epoch: bumped on every put/delete; the device HashPlan
+        # cache (phant_tpu/ops/mpt_jax.py trie_root_device) is keyed on it
+        self._epoch = 0
 
     def put(self, key: bytes, value: bytes) -> None:
         if not value:  # empty value = delete (geth trie semantics)
             self.delete(key)
             return
         self._enc_cache.clear()
+        self._epoch += 1
         self.approx_size += 1
         self.root = _insert(self.root, bytes_to_nibbles(key), value)
 
@@ -259,6 +263,7 @@ class Trie:
         """Remove `key` with full branch-collapse/extension-merge
         re-normalization (no-op when absent)."""
         self._enc_cache.clear()
+        self._epoch += 1
         self.approx_size = max(self.approx_size - 1, 0)
         self.root = _delete(self.root, bytes_to_nibbles(key))
 

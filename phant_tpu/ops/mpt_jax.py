@@ -71,13 +71,21 @@ def _encode_template(items) -> Tuple[bytes, List[int]]:
 
 @dataclass
 class HashPlan:
-    """Per-level device layout for one trie."""
+    """Per-level device layout for one trie.
+
+    The plan is value-complete but hash-free: templates carry zeroed 32-byte
+    holes where child digests go, so executing the plan re-derives EVERY
+    node digest from raw bytes — caching a plan caches packing work, never
+    hashes. `device_args` holds the plan's arrays already resident on the
+    device (populated on first execution), so repeated roots of an unchanged
+    trie transfer nothing but the 32-byte result."""
 
     blob: np.ndarray  # (L,) uint8 — all templates + gather/scatter slack
     # per level: offsets (n,), lens (n,), hole_pos (h,), hole_child (h,)
     levels: List[Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]]
     n_nodes: int  # total real nodes
     root_pos: int  # row of the root digest in the global digest buffer
+    device_args: Optional[tuple] = None  # (blob_d, levels_d) jax arrays
 
 
 def build_hash_plan(trie: Trie) -> Optional[HashPlan]:
@@ -157,7 +165,7 @@ def build_hash_plan(trie: Trie) -> Optional[HashPlan]:
     levels = []
     # digest rows are laid out level by level, each level padded to a power
     # of two — remap must use the PADDED cumulative position, since that is
-    # where _hash_level actually writes each level's digests
+    # where the fused executor actually writes each level's digests
     remap = np.zeros(n, np.int64)
     next_global = 0
     scratch = len(blob) - 32  # scatter target for hole padding rows
@@ -198,55 +206,96 @@ def build_hash_plan(trie: Trie) -> Optional[HashPlan]:
 # ---------------------------------------------------------------------------
 
 
-@functools.partial(jax.jit, static_argnames=("max_chunks",))
-def _hash_level(
-    blob, digests, offsets, lens, hole_pos, hole_child, out_start, *, max_chunks: int
-):
-    """Scatter referenced child digests into the blob, hash this level's
-    nodes, and append their digests to the global digest buffer.
+def execute_plan_host(plan: HashPlan) -> bytes:
+    """CPU mirror of the fused device executor: recompute EVERY node digest
+    from the plan's templates (scatter child digests into the holes, batch
+    keccak each level through the native library). This is the honest CPU
+    baseline for the device state-root path — identical inputs, identical
+    recompute-all-hashes semantics, best available host implementation
+    (no RLP re-encoding, one keccak FFI batch per level)."""
+    from phant_tpu.crypto.keccak import keccak256
+    from phant_tpu.utils.native import load_native
 
-    `out_start` is a traced scalar (not static) so one compiled program per
-    (level-shape, buffer-shape) serves every level position — a plan's levels
-    mostly share shapes, keeping compile count low on repeated roots."""
-    # digest words (C, 8) u32 -> bytes (C, 32) u8, little-endian per word
-    d = digests[hole_child]  # (H, 8)
+    native = load_native()
+    blob = plan.blob.copy()
+    total_pad = sum(len(off) for off, _l, _p, _c in plan.levels)
+    digests = np.zeros((total_pad, 32), np.uint8)
+    out_start = 0
+    pos32 = np.arange(32)
+    for off, ln, hole_pos, hole_child in plan.levels:
+        child = digests[hole_child]  # (H, 32)
+        blob[hole_pos[:, None] + pos32[None, :]] = child
+        payloads = [
+            blob[off[k] : off[k] + ln[k]].tobytes() for k in range(len(off))
+        ]
+        if native is not None:
+            hashed = native.keccak256_batch(payloads)
+        else:
+            hashed = [keccak256(p) for p in payloads]
+        digests[out_start : out_start + len(off)] = [
+            np.frombuffer(h, np.uint8) for h in hashed
+        ]
+        out_start += len(off)
+    return digests[plan.root_pos].tobytes()
+
+
+@functools.partial(jax.jit, static_argnames=("max_chunks",))
+def _hash_plan_fused(blob, levels, *, max_chunks: int):
+    """Execute a whole HashPlan in ONE device program: for each level
+    (statically unrolled; shapes are the jit cache key) scatter the child
+    digests into the template holes, hash the level with the batched keccak
+    kernel, and append to the digest buffer. One dispatch replaces the
+    per-level round trips of the old executor — on a high-latency link that
+    is the difference between ~1x and ~{levels}x RTT per root.
+
+    Returns the (8,) u32 root digest words (the root is the unique
+    max-level node, laid out last by build_hash_plan)."""
+    total_pad = sum(off.shape[0] for off, _l, _p, _c in levels)
+    digests = jnp.zeros((total_pad, 8), jnp.uint32)
     shifts = jnp.arange(4, dtype=jnp.uint32) * 8
-    dbytes = ((d[:, :, None] >> shifts[None, None, :]) & 0xFF).astype(jnp.uint8)
-    dbytes = dbytes.reshape(d.shape[0], 32)
-    flat = hole_pos[:, None] + jnp.arange(32, dtype=jnp.int32)[None, :]
-    blob = blob.at[flat.reshape(-1)].set(dbytes.reshape(-1))
-    level_digests = witness_digests(blob, offsets, lens, max_chunks=max_chunks)
-    digests = jax.lax.dynamic_update_slice(
-        digests, level_digests, (out_start, jnp.int32(0))
-    )
-    return blob, digests
+    pos32 = jnp.arange(32, dtype=jnp.int32)
+    out_start = 0
+    for off, ln, hole_pos, hole_child in levels:
+        d = digests[hole_child]  # (H, 8)
+        dbytes = ((d[:, :, None] >> shifts[None, None, :]) & 0xFF).astype(jnp.uint8)
+        flat = hole_pos[:, None] + pos32[None, :]
+        blob = blob.at[flat.reshape(-1)].set(dbytes.reshape(-1))
+        level_digests = witness_digests(blob, off, ln, max_chunks=max_chunks)
+        digests = jax.lax.dynamic_update_slice(
+            digests, level_digests, (out_start, 0)
+        )
+        out_start += off.shape[0]
+    return digests[-1]
 
 
 def trie_root_device(trie: Trie, plan: Optional[HashPlan] = None) -> bytes:
-    """Trie root with all keccak hashing on device; CPU fallback for tries
-    with embedded nodes."""
+    """Trie root with all keccak hashing on device in a single fused
+    dispatch; CPU fallback for tries with embedded nodes.
+
+    Plans are cached on the trie per mutation epoch (phant_tpu/mpt/mpt.py
+    bumps `_epoch` on put/delete): an unchanged trie re-executes the full
+    hash pipeline from device-resident templates — every digest is
+    recomputed on device each call, only the host packing is reused."""
     if trie.root is None:
         return EMPTY_TRIE_ROOT
     if plan is None:
-        plan = build_hash_plan(trie)
+        epoch = getattr(trie, "_epoch", None)
+        cached = getattr(trie, "_device_plan", None)
+        if cached is not None and epoch is not None and cached[0] == epoch:
+            plan = cached[1]
+        else:
+            plan = build_hash_plan(trie)
+            if plan is not None and epoch is not None:
+                trie._device_plan = (epoch, plan)
     if plan is None:
         return trie.root_hash()
 
-    total_pad = sum(len(off) for off, _l, _p, _c in plan.levels)
-    blob = jnp.asarray(plan.blob)
-    digests = jnp.zeros((total_pad, 8), jnp.uint32)
-    out_start = 0
-    for off, ln, hole_pos, hole_child in plan.levels:
-        blob, digests = _hash_level(
-            blob,
-            digests,
-            jnp.asarray(off),
-            jnp.asarray(ln),
-            jnp.asarray(hole_pos),
-            jnp.asarray(hole_child),
-            jnp.int32(out_start),
-            max_chunks=MPT_MAX_CHUNKS,
+    if plan.device_args is None:
+        levels_d = tuple(
+            tuple(jnp.asarray(a) for a in lvl) for lvl in plan.levels
         )
-        out_start += len(off)
-    root_words = np.asarray(digests[plan.root_pos])
+        plan.device_args = (jnp.asarray(plan.blob), levels_d)
+    blob_d, levels_d = plan.device_args
+    assert plan.root_pos == sum(len(off) for off, _l, _p, _c in plan.levels) - 1
+    root_words = _hash_plan_fused(blob_d, levels_d, max_chunks=MPT_MAX_CHUNKS)
     return np.asarray(root_words, dtype="<u4").tobytes()

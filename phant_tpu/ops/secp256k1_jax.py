@@ -462,21 +462,25 @@ def digest_words_to_addresses(words: np.ndarray) -> List[bytes]:
     return [arr[i].tobytes()[12:32] for i in range(arr.shape[0])]
 
 
-def ecrecover_batch(
+def ecrecover_batch_async(
     msg_hashes: Sequence[bytes],
     rs: Sequence[int],
     ss: Sequence[int],
     recovery_ids: Sequence[int],
-) -> List[Optional[bytes]]:
-    """Recover the Ethereum address for each signature on device; None for
-    invalid signatures. recovery_id >= 2 falls back to the CPU backend
+):
+    """Dispatch batched ecrecover and return a zero-argument `resolve()`
+    callable that materializes the result list. The device computes while
+    the host does other work between dispatch and resolve — the building
+    block for cross-block pipelining (phant_tpu/blockchain/chain.py
+    run_blocks prefetches block N+k's senders while block N executes on
+    CPU). recovery_id >= 2 falls back to the CPU backend at dispatch time
     (x = r + n is never produced by Ethereum transactions)."""
     from phant_tpu.crypto.keccak import keccak256
     from phant_tpu.crypto.secp256k1 import SignatureError, recover_pubkey
 
     B = len(msg_hashes)
     if B == 0:
-        return []
+        return lambda: []
     out: List[Optional[bytes]] = [None] * B
     device_idx = [i for i in range(B) if recovery_ids[i] in (0, 1)]
     for i in range(B):
@@ -487,7 +491,7 @@ def ecrecover_batch(
             except SignatureError:
                 out[i] = None
     if not device_idx:
-        return out
+        return lambda: out
     # bucket the batch to a power of two (>= 32) so repeated calls reuse a
     # handful of compiled programs instead of retracing per batch size
     bucket = 32
@@ -505,8 +509,23 @@ def ecrecover_batch(
     digest, valid = ecrecover_kernel(
         jnp.asarray(e), jnp.asarray(r), jnp.asarray(s), jnp.asarray(par)
     )
-    addrs = digest_words_to_addresses(np.asarray(digest))
-    valid_np = np.asarray(valid)
-    for k, i in enumerate(device_idx):
-        out[i] = addrs[k] if bool(valid_np[k]) else None
-    return out
+
+    def resolve() -> List[Optional[bytes]]:
+        addrs = digest_words_to_addresses(np.asarray(digest))
+        valid_np = np.asarray(valid)
+        for k, i in enumerate(device_idx):
+            out[i] = addrs[k] if bool(valid_np[k]) else None
+        return out
+
+    return resolve
+
+
+def ecrecover_batch(
+    msg_hashes: Sequence[bytes],
+    rs: Sequence[int],
+    ss: Sequence[int],
+    recovery_ids: Sequence[int],
+) -> List[Optional[bytes]]:
+    """Recover the Ethereum address for each signature on device; None for
+    invalid signatures. Synchronous wrapper over ecrecover_batch_async."""
+    return ecrecover_batch_async(msg_hashes, rs, ss, recovery_ids)()

@@ -37,6 +37,32 @@ def _pow2ceil(n: int) -> int:
     return p
 
 
+def _gather_node_rows(blob, offsets, lens, row: int):
+    """(B, row) uint8 — each node's bytes sliced out of the blob, zeroed
+    past its length."""
+    pos = jnp.arange(row, dtype=jnp.int32)[None, :]  # (1, row)
+    idx = offsets[:, None] + pos  # (B, row)
+    data = jnp.take(blob, idx, mode="clip")
+    return jnp.where(pos < lens[:, None], data, jnp.uint8(0))
+
+
+def _digests_from_rows(data, lens, *, max_chunks: int):
+    """Keccak-pad gathered node rows and hash them (shared by the meta and
+    fused kernels so a fused program hashes the same rows it parses)."""
+    row = max_chunks * RATE
+    pos = jnp.arange(row, dtype=jnp.int32)[None, :]
+    # keccak multi-rate padding: 0x01 after the payload, 0x80 at the end of
+    # the last rate block
+    nchunks = lens // RATE + 1
+    pad01 = (pos == lens[:, None]).astype(jnp.uint8)
+    pad80 = (pos == nchunks[:, None] * RATE - 1).astype(jnp.uint8) << 7
+    padded = data ^ pad01 ^ pad80
+    # u8 -> little-endian u32 lanes
+    b = padded.reshape(padded.shape[0], max_chunks, RATE // 4, 4).astype(jnp.uint32)
+    words = b[..., 0] | (b[..., 1] << 8) | (b[..., 2] << 16) | (b[..., 3] << 24)
+    return keccak256_chunked(words, nchunks, max_chunks=max_chunks)
+
+
 @functools.partial(jax.jit, static_argnames=("max_chunks",))
 def witness_digests(
     blob: jax.Array,
@@ -57,59 +83,8 @@ def witness_digests(
     Returns:
       (B, 8) uint32 digests (little-endian words).
     """
-    row = max_chunks * RATE
-    pos = jnp.arange(row, dtype=jnp.int32)[None, :]  # (1, row)
-    idx = offsets[:, None] + pos  # (B, row)
-    data = jnp.take(blob, idx, mode="clip")
-    in_range = pos < lens[:, None]
-    data = jnp.where(in_range, data, jnp.uint8(0))
-    # keccak multi-rate padding: 0x01 after the payload, 0x80 at the end of
-    # the last rate block
-    nchunks = lens // RATE + 1
-    pad01 = (pos == lens[:, None]).astype(jnp.uint8)
-    pad80 = (pos == nchunks[:, None] * RATE - 1).astype(jnp.uint8) << 7
-    data = data ^ pad01 ^ pad80
-    # u8 -> little-endian u32 lanes
-    b = data.reshape(data.shape[0], max_chunks, RATE // 4, 4).astype(jnp.uint32)
-    words = b[..., 0] | (b[..., 1] << 8) | (b[..., 2] << 16) | (b[..., 3] << 24)
-    return keccak256_chunked(words, nchunks, max_chunks=max_chunks)
-
-
-@functools.partial(jax.jit, static_argnames=("max_chunks", "n_blocks"))
-def witness_verify(
-    blob: jax.Array,
-    meta: jax.Array,
-    roots: jax.Array,
-    *,
-    max_chunks: int,
-    n_blocks: int,
-) -> jax.Array:
-    """Per-block root-membership verdict, entirely on device.
-
-    meta: (3, B) int32 — rows are (offsets, lens, block_id); fused into one
-      array so a batch costs two host->device transfers (blob + meta), not
-      four dispatches.
-    roots: (n_blocks, 8) uint32 — expected state/trie root per block.
-
-    Returns (n_blocks,) bool — block b is verified iff some node of block b
-    hashes to roots[b]. (Linkage of inner nodes is checked by the host walk
-    in phant_tpu/mpt/proof.py; this kernel covers the hashing-dominated
-    membership check, the hot 90%.)
-    """
-    offsets, lens, block_id = meta[0], meta[1], meta[2]
-    digests = witness_digests(blob, offsets, lens, max_chunks=max_chunks)
-    return partial_verdict(digests, lens, block_id, roots, n_blocks) > 0
-
-
-def partial_verdict(digests, lens, block_id, roots, n_blocks: int):
-    """(n_blocks,) int32 root-membership hits for one shard of nodes.
-
-    Shared by the single-chip path above and the dp-sharded path
-    (__graft_entry__.dryrun_multichip), which pmax-combines shards' results
-    over the mesh — keeping verdict semantics in exactly one place."""
-    valid = lens > 0
-    is_root = jnp.all(digests == roots[block_id], axis=1) & valid
-    return jnp.zeros((n_blocks,), jnp.int32).at[block_id].max(is_root.astype(jnp.int32))
+    data = _gather_node_rows(blob, offsets, lens, max_chunks * RATE)
+    return _digests_from_rows(data, lens, max_chunks=max_chunks)
 
 
 # ---------------------------------------------------------------------------
@@ -219,7 +194,7 @@ def witness_verify_linked(
       hash reference inside the witness nodes (host-scanned, -1 offset = pad).
     roots: (n_blocks, 8) uint32.
 
-    Returns (n_blocks,) bool. Unlike `witness_verify` (root membership only),
+    Returns (n_blocks,) bool. Unlike plain root membership,
     a block passes only if its nodes form a connected subtree rooted at the
     expected root — a witness with a broken parent->child link is rejected.
     """
@@ -230,6 +205,211 @@ def witness_verify_linked(
         digests, lens, block_id, refs, ref_meta[1], ref_meta[0] >= 0, roots, n_blocks
     )
     return (root_hit > 0) & (all_ok > 0)
+
+
+# ---------------------------------------------------------------------------
+# fused verification with ON-DEVICE ref extraction
+#
+# The RLP child-hash references of a trie node are recoverable from at most
+# 17 top-level item-header decodes (all vectorizable gathers):
+#   - a 17-item node (branch) references its 32-byte-string children
+#     (slots 0..15); embedded (<32B) children cannot themselves contain a
+#     33-byte hash reference, so no recursion is ever needed;
+#   - a 2-item node is an extension (item1 if a 32-byte string) or a leaf,
+#     whose account-shaped value commits a storage root at a fixed offset
+#     behind 4 more header decodes.
+# Running this on device removes the ref_meta transfer (~8 bytes per ref,
+# the second-largest h2d stream after the blob itself) AND the host-side
+# native ref scan; the host ships the raw witness plus 4 bytes per node.
+# Mirrors native/packer.cc phant_scan_refs / scan_refs_py bit-for-bit
+# (differential-tested) except that malformed nodes mark themselves ref-less
+# (failing verification) instead of raising.
+# ---------------------------------------------------------------------------
+
+
+def _take_at(data, idx):
+    """(B,) byte of each node row at per-node position idx (clamped)."""
+    j = jnp.clip(idx, 0, data.shape[1] - 1)
+    return jnp.take_along_axis(data, j[:, None], axis=1)[:, 0].astype(jnp.int32)
+
+
+def _decode_rlp_header(data, pos):
+    """Vectorized RLP item-header decode at per-node byte position `pos`.
+
+    Returns (payload_start, payload_len, next_pos, ok, is_list, is_ref)
+    where is_ref flags exactly the 0xa0 header (32-byte string). Length-of-
+    length > 2 cannot occur in <=679B nodes and flags not-ok."""
+    b0 = _take_at(data, pos)
+    b1 = _take_at(data, pos + 1)
+    b2 = _take_at(data, pos + 2)
+    single = b0 < 0x80
+    short_str = (b0 >= 0x80) & (b0 <= 0xB7)
+    long_str = (b0 >= 0xB8) & (b0 <= 0xBF)
+    short_list = (b0 >= 0xC0) & (b0 <= 0xF7)
+    long_list = b0 >= 0xF8
+    lnl = jnp.where(long_str, b0 - 0xB7, jnp.where(long_list, b0 - 0xF7, 0))
+    long_len = jnp.where(lnl == 1, b1, (b1 << 8) | b2)
+    plen = jnp.where(
+        single,
+        1,
+        jnp.where(
+            short_str, b0 - 0x80, jnp.where(short_list, b0 - 0xC0, long_len)
+        ),
+    )
+    ps = jnp.where(single, pos, pos + 1 + lnl)
+    return ps, plen, ps + plen, lnl <= 2, short_list | long_list, b0 == 0xA0
+
+
+def _extract_ref_positions(data, lens):
+    """(B, 17) int32 node-relative offsets of every child hash reference
+    (-1 = no ref in that slot). Slots 0..15 are branch children; slot 16 is
+    the extension child or the account-leaf storage root."""
+    end = lens.astype(jnp.int32)
+    zero = jnp.zeros_like(end)
+    ps0, _plen0, pe0, ok0, islist0, _ = _decode_rlp_header(data, zero)
+    bad = ~(ok0 & islist0 & (pe0 == end) & (end > 0))
+
+    pos = ps0
+    item_ps = []
+    item_pe = []
+    item_ref = []
+    item_valid = []
+    for _k in range(17):
+        ps, _plen, nxt, ok, is_list, is_ref = _decode_rlp_header(data, pos)
+        valid = (pos < end) & ~bad
+        overrun = valid & (~ok | (nxt > end))
+        bad = bad | overrun
+        valid = valid & ~overrun
+        item_ps.append(jnp.where(valid, ps, 0))
+        item_pe.append(jnp.where(valid, nxt, 0))
+        item_ref.append(valid & is_ref & ~is_list)
+        item_valid.append(valid)
+        pos = jnp.where(valid, nxt, pos)
+    bad = bad | (pos != end)  # 18+ items, or trailing garbage
+
+    n_items = sum(v.astype(jnp.int32) for v in item_valid)
+    is_branch = (n_items == 17) & ~bad
+    is_pair = (n_items == 2) & ~bad
+
+    # branch: slots 0..15 that are 32-byte strings
+    branch_refs = [
+        jnp.where(is_branch & item_ref[k], item_ps[k], -1) for k in range(16)
+    ]
+
+    # pair: hex-prefix flag byte of item 0 (empty path = malformed)
+    p0 = _take_at(data, item_ps[0])
+    nonempty0 = (item_pe[0] - item_ps[0]) > 0
+    is_ext = is_pair & nonempty0 & ((p0 & 0x20) == 0)
+    is_leaf = is_pair & nonempty0 & ((p0 & 0x20) != 0)
+    ext_ref = jnp.where(is_ext & item_ref[1], item_ps[1], -1)
+
+    # leaf: item1 must be a string whose content is a 4-string account list
+    # with 32-byte items 2 and 3 (mirrors _account_storage_root_off)
+    v_ps, v_pe = item_ps[1], item_pe[1]
+    l_ps, _lp, l_pe, l_ok, l_islist, _ = _decode_rlp_header(data, v_ps)
+    acct = is_leaf & ~item_ref[1] & l_ok & l_islist & (l_pe == v_pe)
+    q_ps, _qp, q_pe, q_ok, q_islist, _ = _decode_rlp_header(data, l_ps)  # nonce
+    acct = acct & q_ok & ~q_islist & (q_pe <= l_pe)
+    r_ps, _rp, r_pe, r_ok, r_islist, _ = _decode_rlp_header(data, q_pe)  # balance
+    acct = acct & r_ok & ~r_islist & (r_pe <= l_pe)
+    acct = (
+        acct
+        & (_take_at(data, r_pe) == 0xA0)
+        & (_take_at(data, r_pe + 33) == 0xA0)
+        & (r_pe + 66 == l_pe)
+    )
+    leaf_ref = jnp.where(acct, r_pe + 1, -1)
+
+    slot16 = jnp.where(is_ext, ext_ref, leaf_ref)
+    return jnp.stack(branch_refs + [slot16], axis=1)
+
+
+def _ref_words_from_rows(data, ref_pos):
+    """(B, 17, 8) u32 LE words of the 32-byte refs at node-relative ref_pos
+    (dead slots gather garbage; callers mask with ref_pos >= 0)."""
+    B = data.shape[0]
+    idx = jnp.clip(ref_pos, 0, data.shape[1] - 33)[:, :, None] + jnp.arange(
+        32, dtype=jnp.int32
+    )[None, None, :]
+    b = jnp.take_along_axis(data, idx.reshape(B, -1), axis=1).reshape(
+        B, 17, 8, 4
+    ).astype(jnp.uint32)
+    return b[..., 0] | (b[..., 1] << 8) | (b[..., 2] << 16) | (b[..., 3] << 24)
+
+
+@functools.partial(jax.jit, static_argnames=("max_chunks", "n_blocks"))
+def witness_verify_fused(
+    blob: jax.Array,
+    meta16: jax.Array,
+    roots: jax.Array,
+    *,
+    max_chunks: int,
+    n_blocks: int,
+) -> jax.Array:
+    """Full linked multiproof verification from the raw witness alone.
+
+    meta16: (2, B) uint16 — (len, block_id) per node, in blob order (0-len =
+      pad). Offsets are an on-device exclusive cumsum: the blob IS the
+      concatenation of the nodes. Child references are parsed out of the
+      node bytes on device (_extract_ref_positions) — host->device traffic
+      is the witness bytes + 4 bytes per node, nothing else.
+
+    Semantics identical to witness_verify_linked: a block verifies iff its
+    nodes form a connected subtree rooted at its expected root.
+    """
+    lens = meta16[0].astype(jnp.int32)
+    block_id = meta16[1].astype(jnp.int32)
+    offsets = jnp.cumsum(lens) - lens  # exclusive
+    data = _gather_node_rows(blob, offsets, lens, max_chunks * RATE)
+    digests = _digests_from_rows(data, lens, max_chunks=max_chunks)
+    ref_pos = _extract_ref_positions(data, lens)
+    refs = _ref_words_from_rows(data, ref_pos).reshape(-1, 8)
+    ref_live = (ref_pos >= 0).reshape(-1)
+    ref_block = jnp.broadcast_to(block_id[:, None], ref_pos.shape).reshape(-1)
+    root_hit, all_ok = linked_verdict(
+        digests, lens, block_id, refs, ref_block, ref_live, roots, n_blocks
+    )
+    return (root_hit > 0) & (all_ok > 0)
+
+
+def pack_witness_fused(
+    node_lists: Sequence[Sequence[bytes]],
+    max_chunks: int,
+    pad_nodes_to: int | None = None,
+    min_pad: int = 1,
+) -> Tuple[np.ndarray, np.ndarray]:
+    """(blob, meta16) for `witness_verify_fused`: the concatenated witness
+    bytes plus (2, B) uint16 (len, block_id) rows — no offsets, no host ref
+    scan. The cheapest possible host-side layout (~4 bytes/node of metadata
+    vs 12 + 8/ref for the explicit-refs path)."""
+    parts: List[bytes] = [n for nodes in node_lists for n in nodes]
+    B = len(parts)
+    counts = np.fromiter(
+        (len(nodes) for nodes in node_lists), np.int64, len(node_lists)
+    )
+    lens_arr = np.fromiter((len(n) for n in parts), np.int64, B)
+    if len(node_lists) > 0xFFFF:
+        raise ValueError("block_id exceeds uint16; split the batch")
+    if B and (lens_arr // RATE + 1 > max_chunks).any():
+        raise ValueError(
+            f"node of {int(lens_arr.max())}B exceeds bucket bound {max_chunks}"
+        )
+    if int(lens_arr.sum()) >= 2**31:
+        raise ValueError("witness blob exceeds int32 offset range; split the batch")
+    target = pad_nodes_to
+    if target is None:
+        target = _pow2ceil(max(B, min_pad))
+    if B > target:
+        raise ValueError(f"{B} nodes exceed pad_nodes_to={target}")
+    meta16 = np.zeros((2, target), np.uint16)
+    meta16[0, :B] = lens_arr
+    meta16[1, :B] = np.repeat(
+        np.arange(len(node_lists), dtype=np.uint16), counts
+    )
+    blob = np.frombuffer(
+        b"".join(parts) + b"\x00" * (max_chunks * RATE), dtype=np.uint8
+    )
+    return blob, meta16
 
 
 # ---------------------------------------------------------------------------

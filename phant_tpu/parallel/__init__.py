@@ -5,8 +5,8 @@ from phant_tpu.parallel.mesh import (
     init_distributed,
     make_mesh,
     shard_map,
+    witness_verify_fused_sharded,
     witness_verify_linked_sharded,
-    witness_verify_sharded,
 )
 
 __all__ = [
@@ -14,6 +14,6 @@ __all__ = [
     "init_distributed",
     "make_mesh",
     "shard_map",
+    "witness_verify_fused_sharded",
     "witness_verify_linked_sharded",
-    "witness_verify_sharded",
 ]

@@ -23,11 +23,15 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from phant_tpu.crypto.keccak import RATE
 from phant_tpu.ops.witness_jax import (
     WITNESS_MAX_CHUNKS,
+    _digests_from_rows,
+    _extract_ref_positions,
+    _gather_node_rows,
     _gather_refs,
+    _ref_words_from_rows,
     linked_verdict,
-    partial_verdict,
     witness_digests,
 )
 
@@ -72,23 +76,26 @@ def init_distributed(
 # ---------------------------------------------------------------------------
 
 
-def witness_verify_sharded(
+def witness_verify_fused_sharded(
     mesh: Mesh,
     blob,
-    meta,
+    meta16,
     roots,
     *,
     max_chunks: int = WITNESS_MAX_CHUNKS,
     n_blocks: Optional[int] = None,
 ):
-    """Per-block root-membership verdicts with the node axis sharded over
-    the mesh's `dp` axis. The blob and roots are replicated (nodes of one
-    block may land on any shard); each shard hashes its nodes and the
-    per-block partial verdicts are combined with a pmax collective.
+    """The flagship fused kernel (on-device RLP ref extraction,
+    phant_tpu/ops/witness_jax.py witness_verify_fused) with the node axis
+    sharded over `dp`. Each shard gathers its node rows from the replicated
+    blob, hashes them, and parses its own nodes' child refs on device; node
+    lengths are all_gather-ed once for the global offset prefix-sum, and the
+    per-shard ref slices are all_gather-ed for the linkage join (a node's
+    parent may sit on any shard — these are the collectives that ride ICI).
+    Per-block partials combine with pmax (root hit) / pmin (all linked).
 
-    meta columns must be divisible by the mesh size (pad_witness uses
-    power-of-two node counts, so any power-of-two mesh divides it).
-    """
+    The node axis must be divisible by the mesh size (pack_witness_fused
+    pads to powers of two)."""
     if n_blocks is None:
         n_blocks = int(roots.shape[0])
     axis = mesh.axis_names[0]
@@ -100,16 +107,37 @@ def witness_verify_sharded(
         out_specs=P(),
     )
     def inner(blob_s, meta_s, roots_s):
-        offsets, lens, block_id = meta_s[0], meta_s[1], meta_s[2]
-        digests = witness_digests(blob_s, offsets, lens, max_chunks=max_chunks)
-        partial = partial_verdict(digests, lens, block_id, roots_s, n_blocks)
-        return jax.lax.pmax(partial, axis)
+        lens_l = meta_s[0].astype(jnp.int32)
+        block_l = meta_s[1].astype(jnp.int32)
+        nloc = lens_l.shape[0]
+        lens_all = jax.lax.all_gather(lens_l, axis, axis=0, tiled=True)
+        off_all = jnp.cumsum(lens_all) - lens_all  # exclusive global offsets
+        i = jax.lax.axis_index(axis)
+        offsets_l = jax.lax.dynamic_slice(off_all, (i * nloc,), (nloc,))
+        data = _gather_node_rows(blob_s, offsets_l, lens_l, max_chunks * RATE)
+        digests = _digests_from_rows(data, lens_l, max_chunks=max_chunks)
+        ref_pos = _extract_ref_positions(data, lens_l)
+        refs_l = _ref_words_from_rows(data, ref_pos).reshape(-1, 8)
+        live_l = (ref_pos >= 0).reshape(-1)
+        rblock_l = jnp.broadcast_to(block_l[:, None], ref_pos.shape).reshape(-1)
+        refs = jax.lax.all_gather(refs_l, axis, axis=0, tiled=True)
+        ref_block = jax.lax.all_gather(rblock_l, axis, axis=0, tiled=True)
+        ref_live = jax.lax.all_gather(live_l, axis, axis=0, tiled=True)
+        root_hit, all_ok = linked_verdict(
+            digests, lens_l, block_l, refs, ref_block, ref_live, roots_s, n_blocks
+        )
+        return jnp.stack(
+            [jax.lax.pmax(root_hit, axis), jax.lax.pmin(all_ok, axis)]
+        )
 
     repl = NamedSharding(mesh, P())
-    blob_d = jax.device_put(jnp.asarray(blob), repl)
-    meta_d = jax.device_put(jnp.asarray(meta), NamedSharding(mesh, P(None, mesh.axis_names[0])))
-    roots_d = jax.device_put(jnp.asarray(roots), repl)
-    return jax.jit(inner)(blob_d, meta_d, roots_d) > 0
+    col = NamedSharding(mesh, P(None, axis))
+    out = jax.jit(inner)(
+        jax.device_put(jnp.asarray(blob), repl),
+        jax.device_put(jnp.asarray(meta16), col),
+        jax.device_put(jnp.asarray(roots), repl),
+    )
+    return (out[0] > 0) & (out[1] > 0)
 
 
 def witness_verify_linked_sharded(

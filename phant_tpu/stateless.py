@@ -470,16 +470,15 @@ def verify_witness_nodes(state_root: bytes, nodes: List[bytes]) -> bool:
 
         from phant_tpu.ops.witness_jax import (
             WITNESS_MAX_CHUNKS,
-            pack_witness,
+            pack_witness_fused,
             roots_to_words,
-            witness_verify_linked,
+            witness_verify_fused,
         )
 
-        blob, meta, ref_meta = pack_witness([nodes], WITNESS_MAX_CHUNKS)
-        out = witness_verify_linked(
+        blob, meta16 = pack_witness_fused([nodes], WITNESS_MAX_CHUNKS)
+        out = witness_verify_fused(
             jnp.asarray(blob),
-            jnp.asarray(meta),
-            jnp.asarray(ref_meta),
+            jnp.asarray(meta16),
             jnp.asarray(roots_to_words([state_root])),
             max_chunks=WITNESS_MAX_CHUNKS,
             n_blocks=1,

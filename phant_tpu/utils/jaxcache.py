@@ -1,0 +1,25 @@
+"""Persistent XLA compilation cache (shared by bench.py and the tests).
+
+The kernels are identical across processes; recompiling the 256-step
+ecrecover ladder per run costs minutes. Best-effort: older jax without the
+persistent cache just runs uncached."""
+
+from __future__ import annotations
+
+import os
+
+
+def enable_compile_cache(cache_dir: str | None = None) -> None:
+    try:
+        import jax
+
+        cache = cache_dir or os.environ.get(
+            "PHANT_JAX_CACHE",
+            os.path.join(os.path.dirname(__file__), "..", "..", "build", "jax_cache"),
+        )
+        cache = os.path.abspath(cache)
+        os.makedirs(cache, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", cache)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+    except Exception:
+        pass

@@ -22,6 +22,8 @@ if os.environ.get("PHANT_TEST_TPU", "0") in ("", "0"):
     os.environ["PHANT_ALLOW_JAX_CPU"] = "1"
     os.environ.setdefault("PHANT_TPU_MIN_TRIE", "1")  # small test tries must
     # still exercise the device dispatch path
+    os.environ.setdefault("PHANT_TPU_MIN_ECRECOVER", "1")  # likewise for the
+    # batched device ecrecover (production floor is 64)
     flags = os.environ.get("XLA_FLAGS", "")
     if "xla_force_host_platform_device_count" not in flags:
         os.environ["XLA_FLAGS"] = (
@@ -37,7 +39,16 @@ if os.environ.get("PHANT_TEST_TPU", "0") in ("", "0"):
     jax.config.update("jax_platforms", "cpu")
 
 
-import pytest
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from phant_tpu.utils.jaxcache import enable_compile_cache  # noqa: E402
+
+enable_compile_cache()
+
+
+import pytest  # noqa: E402
 
 
 @pytest.fixture(params=["python", "native", "tpu"])

@@ -16,16 +16,16 @@ def test_entry_compiles_and_verifies():
     fn, args = graft.entry()
     out = jax.jit(fn)(*args)
     out.block_until_ready()
-    n_blocks = args[3].shape[0]
+    n_blocks = args[2].shape[0]
     assert out.shape == (n_blocks,)
     # every example block's root is the hash of one of its nodes
     assert np.asarray(out).all()
 
     # corrupting a root must flip that block's verdict
-    bad_roots = np.asarray(args[3]).copy()
+    bad_roots = np.asarray(args[2]).copy()
     bad_roots[0] ^= 1
     out_bad = np.asarray(
-        jax.jit(fn)(args[0], args[1], args[2], jax.numpy.asarray(bad_roots))
+        jax.jit(fn)(args[0], args[1], jax.numpy.asarray(bad_roots))
     )
     assert not out_bad[0] and out_bad[1:].all()
 

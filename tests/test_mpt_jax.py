@@ -128,3 +128,23 @@ def test_trie_root_hash_dispatch():
         assert trie_root_hash(t) == t.root_hash()
     finally:
         set_crypto_backend("cpu")
+
+
+def test_plan_cache_invalidated_on_mutation():
+    """trie_root_device caches the HashPlan per mutation epoch; a put or
+    delete must invalidate it (stale plans would silently hash old bytes)."""
+    from phant_tpu.crypto.keccak import keccak256
+    from phant_tpu.ops.mpt_jax import trie_root_device
+
+    trie = Trie()
+    for i in range(40):
+        trie.put(keccak256(bytes([i])), b"v" * 40)
+    r1 = trie_root_device(trie)
+    assert r1 == trie.root_hash()
+    assert trie._device_plan is not None
+    trie.put(keccak256(bytes([100])), b"w" * 40)
+    r2 = trie_root_device(trie)
+    assert r2 == trie.root_hash() and r2 != r1
+    trie.delete(keccak256(bytes([100])))
+    r3 = trie_root_device(trie)
+    assert r3 == trie.root_hash() == r1

@@ -12,27 +12,40 @@ import pytest
 from phant_tpu.crypto.keccak import keccak256
 from phant_tpu.ops.witness_jax import (
     WITNESS_MAX_CHUNKS,
-    pack_witness_blob,
+    pack_witness_fused,
     roots_to_words,
-    witness_verify,
+    witness_verify_fused,
 )
-from phant_tpu.parallel import make_mesh, witness_verify_sharded
+from phant_tpu.parallel import make_mesh, witness_verify_fused_sharded
 
 import jax
 import jax.numpy as jnp
 
 
-def _witness_case(n_blocks=6, nodes_per_block=8, pad_to=64, corrupt=()):
-    rng = np.random.default_rng(42)
-    node_lists = [
-        [rng.bytes(int(rng.integers(32, 577))) for _ in range(nodes_per_block)]
-        for _ in range(n_blocks)
-    ]
-    roots = [keccak256(nodes[0]) for nodes in node_lists]
-    for b in corrupt:
-        roots[b] = b"\x00" * 32  # no node hashes to this
-    blob, meta = pack_witness_blob(node_lists, WITNESS_MAX_CHUNKS, pad_nodes_to=pad_to)
-    return blob, meta, roots_to_words(roots)
+def _linked_witness_case(n_blocks=6, corrupt=()):
+    """Real multiproof witnesses so linkage genuinely holds."""
+    from phant_tpu import rlp
+    from phant_tpu.mpt.mpt import Trie
+    from phant_tpu.mpt.proof import generate_proof
+
+    rng = np.random.default_rng(7)
+    trie = Trie()
+    keys = []
+    for _ in range(96):
+        k = keccak256(rng.bytes(20))
+        trie.put(k, rlp.encode(rng.bytes(40)))
+        keys.append(k)
+    roots = []
+    node_lists = []
+    for b in range(n_blocks):
+        idx = rng.choice(len(keys), size=6, replace=False)
+        nodes: dict = {}
+        for i in idx:
+            for enc in generate_proof(trie, keys[i]):
+                nodes[enc] = None
+        node_lists.append(list(nodes))
+        roots.append(trie.root_hash() if b not in corrupt else b"\x00" * 32)
+    return node_lists, roots_to_words(roots)
 
 
 def test_make_mesh_sizes():
@@ -45,22 +58,29 @@ def test_make_mesh_sizes():
 
 
 @pytest.mark.parametrize("n_devices", [2, 8])
-def test_witness_verify_sharded_matches_single(n_devices):
-    blob, meta, roots = _witness_case(corrupt=(3,))
+def test_witness_verify_fused_sharded_matches_single(n_devices):
+    """The flagship fused kernel sharded over the mesh must agree with the
+    single-device fused verdict (incl. a corrupted block)."""
+    node_lists, roots = _linked_witness_case(corrupt=(3,))
+    blob, meta16 = pack_witness_fused(node_lists, WITNESS_MAX_CHUNKS, min_pad=64)
     single = np.asarray(
-        witness_verify(
-            jnp.asarray(blob), jnp.asarray(meta), jnp.asarray(roots),
-            max_chunks=WITNESS_MAX_CHUNKS, n_blocks=roots.shape[0],
+        witness_verify_fused(
+            jnp.asarray(blob),
+            jnp.asarray(meta16),
+            jnp.asarray(roots),
+            max_chunks=WITNESS_MAX_CHUNKS,
+            n_blocks=roots.shape[0],
         )
     )
     mesh = make_mesh(n_devices)
-    sharded = np.asarray(witness_verify_sharded(mesh, blob, meta, roots))
+    sharded = np.asarray(witness_verify_fused_sharded(mesh, blob, meta16, roots))
     assert (sharded == single).all()
     assert not sharded[3] and sharded.sum() == roots.shape[0] - 1
 
 
-def test_witness_verify_sharded_all_valid():
-    blob, meta, roots = _witness_case(n_blocks=4, nodes_per_block=4, pad_to=32)
+def test_witness_verify_fused_sharded_all_valid():
+    node_lists, roots = _linked_witness_case(n_blocks=4)
+    blob, meta16 = pack_witness_fused(node_lists, WITNESS_MAX_CHUNKS, min_pad=32)
     mesh = make_mesh(8)
-    out = np.asarray(witness_verify_sharded(mesh, blob, meta, roots))
+    out = np.asarray(witness_verify_fused_sharded(mesh, blob, meta16, roots))
     assert out.all() and out.shape == (4,)

@@ -11,9 +11,10 @@ from phant_tpu.mpt.proof import generate_proof, verify_witness
 from phant_tpu.ops.witness_jax import (
     WITNESS_MAX_CHUNKS as MAX_CHUNKS,
     pack_witness_blob,
+    pack_witness_fused,
     roots_to_words,
     witness_digests,
-    witness_verify,
+    witness_verify_fused,
 )
 
 
@@ -52,7 +53,7 @@ def test_witness_digests_match_cpu():
     assert (got[: len(payloads)] == exp).all()
 
 
-def test_witness_verify_blocks():
+def test_witness_verify_fused_blocks():
     blocks = [_trie_with_proofs(seed=s) for s in range(4)]
     # CPU oracle agrees these witnesses are complete
     for root, entries, nodes in blocks:
@@ -60,11 +61,11 @@ def test_witness_verify_blocks():
 
     node_lists = [nodes for _r, _e, nodes in blocks]
     roots = roots_to_words([r for r, _e, _n in blocks])
-    blob, meta = pack_witness_blob(node_lists, MAX_CHUNKS)
+    blob, meta16 = pack_witness_fused(node_lists, MAX_CHUNKS)
     ok = np.asarray(
-        witness_verify(
+        witness_verify_fused(
             jnp.asarray(blob),
-            jnp.asarray(meta),
+            jnp.asarray(meta16),
             jnp.asarray(roots),
             max_chunks=MAX_CHUNKS,
             n_blocks=len(blocks),
@@ -76,9 +77,9 @@ def test_witness_verify_blocks():
     bad = roots.copy()
     bad[2] ^= 0xFF
     ok = np.asarray(
-        witness_verify(
+        witness_verify_fused(
             jnp.asarray(blob),
-            jnp.asarray(meta),
+            jnp.asarray(meta16),
             jnp.asarray(bad),
             max_chunks=MAX_CHUNKS,
             n_blocks=len(blocks),
