@@ -105,6 +105,7 @@ def main(argv=None) -> int:
         # stateless serving starts from an untracked state: roots for
         # arbitrary payloads can't be checked without the parent state
         verify_state_root=False,
+        config=config,
     )
 
     server = EngineAPIServer(chain, host=args.host, port=args.engine_api_port)

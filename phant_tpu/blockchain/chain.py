@@ -67,6 +67,7 @@ class Blockchain:
         parent_header: BlockHeader,
         fork: Optional[Fork] = None,
         verify_state_root: bool = True,
+        config=None,
     ):
         self.chain_id = chain_id
         self.state = state
@@ -74,6 +75,9 @@ class Blockchain:
         self.fork = fork if fork is not None else FrontierFork()
         self.signer = TxSigner(chain_id)
         self.verify_state_root = verify_state_root
+        # chain config (fork-activation schedule); the stateless handler
+        # uses it to pick the fork for witness-backed execution
+        self.config = config
 
     # ------------------------------------------------------------------
 

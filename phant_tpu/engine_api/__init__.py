@@ -363,8 +363,23 @@ def execute_stateless_payload_v1_handler(
         )
     try:
         headers = witness_json.get("headers") or []
+        ancestors = []
         if headers:
-            parent = BlockHeader.from_rlp_list(rlp.decode(hex_to_bytes(headers[0])))
+            try:
+                ancestors = [
+                    BlockHeader.from_rlp_list(rlp.decode(hex_to_bytes(h)))
+                    for h in headers
+                ]
+            except (rlp.DecodeError, ValueError, KeyError, IndexError) as e:
+                # a malformed witness is an INVALID payload status, not a
+                # JSON-RPC protocol error — callers branch on result.status
+                return StatelessPayloadStatusV1(
+                    status="INVALID",
+                    state_root=zero,
+                    receipt_root=zero,
+                    validator_error=f"witness header does not decode: {e}",
+                )
+            parent = ancestors[0]
             if parent.hash() != block.header.parent_hash:
                 return StatelessPayloadStatusV1(
                     status="INVALID",
@@ -372,6 +387,21 @@ def execute_stateless_payload_v1_handler(
                     receipt_root=zero,
                     validator_error="witness parent header does not match payload parentHash",
                 )
+            # authenticate the whole ancestor chain: header i+1 must be the
+            # parent of header i, anchoring every hash to the verified
+            # parent — an unlinked header could inject a forged BLOCKHASH
+            # (reference behavior being mirrored: the Frontier 256-ancestor
+            # ring, src/blockchain/forks/frontier.zig:29-58)
+            for i in range(len(ancestors) - 1):
+                if ancestors[i].parent_hash != ancestors[i + 1].hash():
+                    return StatelessPayloadStatusV1(
+                        status="INVALID",
+                        state_root=zero,
+                        receipt_root=zero,
+                        validator_error=(
+                            f"witness header {i + 1} does not chain to header {i}"
+                        ),
+                    )
         else:
             parent = blockchain.parent_header
         if "preStateRoot" in witness_json:
@@ -380,9 +410,32 @@ def execute_stateless_payload_v1_handler(
             pre_root = parent.state_root
         nodes = [hex_to_bytes(n) for n in witness_json.get("state", [])]
         codes = [hex_to_bytes(c) for c in witness_json.get("codes", [])]
-        # fork=None -> a fresh FrontierFork: the node's fork instance may be
-        # bound to the node's own StateDB (PragueFork writes EIP-2935 slots),
-        # and a stateless run must not touch resident state
+        # fork selection mirrors the node's own (fork_for over the chain
+        # config), but the instance binds to the STATELESS state: the node's
+        # resident fork may be bound to its resident StateDB (PragueFork
+        # writes EIP-2935 slots), and a stateless run must not touch
+        # resident state. Frontier-family forks are preloaded with the
+        # authenticated ancestor hashes (BLOCKHASH at depth <= 256 serves
+        # witness headers; deeper reads return zero — the EVM enforces the
+        # window). Prague-family forks read/write history through the
+        # witnessed EIP-2935 contract storage instead, so the history write
+        # lands in the recomputed post root exactly as in full execution.
+        from phant_tpu.blockchain.fork import FrontierFork, fork_for
+
+        config = getattr(blockchain, "config", None)
+
+        def fork_factory(state):
+            if config is not None:
+                fork = fork_for(
+                    config, state, block.header.block_number, block.header.timestamp
+                )
+            else:
+                fork = FrontierFork()
+            if isinstance(fork, FrontierFork):
+                for h in ancestors[:256]:
+                    fork.update_parent_block_hash(h.block_number, h.hash())
+            return fork
+
         _result, post_root = execute_stateless(
             blockchain.chain_id,
             parent,
@@ -390,6 +443,7 @@ def execute_stateless_payload_v1_handler(
             pre_root,
             nodes,
             codes,
+            fork_factory=fork_factory,
         )
     except (StatelessError, BlockError) as e:
         return StatelessPayloadStatusV1(

@@ -100,11 +100,125 @@ def diff_post_state(fixture: Fixture, state: StateDB) -> None:
             )
 
 
-def run_directory(root: Path) -> RunStats:
+def _witness_of_state(accounts) -> tuple:
+    """(state_root, nodes, codes): the FULL state trie (accounts + storage
+    subtrees) as a witness. Fixture states are tiny, so the complete trie is
+    the simplest provably-sufficient witness — it exercises the whole
+    stateless machinery (partial-trie reads/writes, deletion collapse,
+    storage-root recompute) with every sibling available."""
+    from phant_tpu.mpt.mpt import BranchNode, ExtensionNode, Trie
+    from phant_tpu.state.root import build_state_trie, build_storage_trie
+
+    nodes: dict = {}
+
+    def collect(trie: Trie) -> None:
+        if trie.root is None:
+            return
+
+        def walk(node):
+            _s, enc = trie.node_encoding(node)
+            if len(enc) >= 32 or node is trie.root:
+                nodes[enc] = None
+            if isinstance(node, ExtensionNode):
+                walk(node.child)
+            elif isinstance(node, BranchNode):
+                for child in node.children:
+                    if child is not None:
+                        walk(child)
+
+        walk(trie.root)
+
+    codes: dict = {}
+    for acct in accounts.values():
+        if acct.code:
+            codes[acct.code] = None
+        if any(v for v in acct.storage.values()):
+            collect(build_storage_trie(acct.storage))
+    trie = build_state_trie(accounts)
+    collect(trie)
+    return trie.root_hash(), list(nodes), list(codes)
+
+
+def run_fixture_stateless(fixture: Fixture) -> None:
+    """The fixture oracle through `execute_stateless`: every valid block is
+    re-executed from ONLY a witness of its pre-state (no resident StateDB)
+    and must produce the header's post-state root; every expectException
+    block must be rejected statelessly too. A full-state shadow chain rolls
+    the canonical state forward between blocks (it is the witness source,
+    exactly the role a stateful node plays for a stateless client)."""
+    from phant_tpu.blockchain.fork import FrontierFork
+    from phant_tpu.stateless import StatelessError, execute_stateless
+
+    state = StateDB({addr: acct.copy() for addr, acct in fixture.pre.items()})
+    genesis = Block.decode(fixture.genesis_rlp)
+    shadow = Blockchain(
+        chain_id=1, state=state, parent_header=genesis.header
+    )
+
+    past_headers = [genesis.header]
+    for i, fb in enumerate(fixture.blocks):
+        pre_root, nodes, codes = _witness_of_state(state.accounts)
+        parent = shadow.parent_header
+        try:
+            block = Block.decode(fb.rlp)
+            decode_ok = True
+        except (rlp.DecodeError, ValueError, KeyError, IndexError):
+            decode_ok = False
+        if decode_ok:
+            fork = FrontierFork()
+            for h in past_headers[-256:]:
+                fork.update_parent_block_hash(h.block_number, h.hash())
+            try:
+                _result, post_root = execute_stateless(
+                    1, parent, block, pre_root, nodes, codes, fork=fork
+                )
+                stateless_ok = True
+            except (StatelessError, BlockError, ValueError, KeyError, IndexError) as e:
+                stateless_ok = False
+                stateless_err = e
+        else:
+            stateless_ok = False
+            stateless_err = "block RLP does not decode"
+
+        if fb.expect_exception:
+            if stateless_ok:
+                raise FixtureFailure(
+                    f"{fixture.name}: block {i} expected exception "
+                    f"{fb.expect_exception!r} but stateless execution passed"
+                )
+            continue
+        if not stateless_ok:
+            raise FixtureFailure(
+                f"{fixture.name}: block {i} failed statelessly: {stateless_err}"
+            )
+        if post_root != block.header.state_root:
+            raise FixtureFailure(
+                f"{fixture.name}: block {i} stateless post root "
+                f"{post_root.hex()} != header {block.header.state_root.hex()}"
+            )
+        # roll the canonical state forward for the next block's witness
+        shadow.run_block(block)
+        past_headers.append(block.header)
+        if shadow.state.state_root() != post_root:
+            raise FixtureFailure(
+                f"{fixture.name}: block {i} stateless/full state-root divergence"
+            )
+
+    last_valid_hash = shadow.parent_header.hash()
+    if last_valid_hash != fixture.last_block_hash:
+        raise FixtureFailure(
+            f"{fixture.name}: lastblockhash mismatch "
+            f"{last_valid_hash.hex()} != {fixture.last_block_hash.hex()}"
+        )
+    diff_post_state(fixture, state)
+
+
+def run_directory(root: Path, stateless: bool = False) -> RunStats:
     stats = RunStats()
+    runner = run_fixture_stateless if stateless else run_fixture
     for path, fixture in walk_fixtures(root):
         try:
-            run_fixture(fixture)
+            runner(fixture)
             stats.passed += 1
         except Exception as e:  # noqa: BLE001 — collect everything for the report
             stats.failed += 1
@@ -117,10 +231,16 @@ def main() -> int:
 
     parser = argparse.ArgumentParser(description="Run execution-spec-tests fixtures")
     parser.add_argument("root", type=Path, help="fixture directory")
+    parser.add_argument(
+        "--stateless",
+        action="store_true",
+        help="re-execute every block from a witness of its pre-state "
+        "(the engine_executeStatelessPayloadV1 machinery)",
+    )
     args = parser.parse_args()
     if not args.root.is_dir():
         parser.error(f"fixture directory not found: {args.root}")
-    stats = run_directory(args.root)
+    stats = run_directory(args.root, stateless=args.stateless)
     if stats.passed + stats.failed == 0:
         parser.error(f"no fixture JSONs under {args.root}")
     for line in stats.failures:

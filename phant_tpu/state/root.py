@@ -17,14 +17,21 @@ from phant_tpu.mpt.mpt import Trie, trie_root_hash
 from phant_tpu.types.account import Account
 
 
-def storage_root(storage: Mapping[int, int]) -> bytes:
+def build_storage_trie(storage: Mapping[int, int]) -> Trie:
+    """slot -> value trie (zero slots are absent). The single source of the
+    storage-trie key/leaf encoding — witness generation walks these same
+    tries (phant_tpu/spec/runner.py _witness_of_state)."""
     trie = Trie()
     for slot, value in storage.items():
         if value == 0:
-            continue  # zero slots are absent from the trie
+            continue
         key = keccak256(slot.to_bytes(32, "big"))
         trie.put(key, rlp.encode(rlp.encode_uint(value)))
-    return trie_root_hash(trie)
+    return trie
+
+
+def storage_root(storage: Mapping[int, int]) -> bytes:
+    return trie_root_hash(build_storage_trie(storage))
 
 
 def account_leaf(account: Account) -> bytes:
@@ -36,11 +43,16 @@ def account_leaf(account: Account) -> bytes:
     ])
 
 
-def state_root(accounts: Mapping[bytes, Account]) -> bytes:
-    """Root over address -> account, skipping EIP-161-empty accounts."""
+def build_state_trie(accounts: Mapping[bytes, Account]) -> Trie:
+    """address -> account trie, skipping EIP-161-empty accounts."""
     trie = Trie()
     for address, account in accounts.items():
         if account.is_empty() and not account.storage:
             continue
         trie.put(keccak256(address), account_leaf(account))
-    return trie_root_hash(trie)
+    return trie
+
+
+def state_root(accounts: Mapping[bytes, Account]) -> bytes:
+    """Root over address -> account, skipping EIP-161-empty accounts."""
+    return trie_root_hash(build_state_trie(accounts))

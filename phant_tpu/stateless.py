@@ -497,15 +497,24 @@ def execute_stateless(
     nodes: List[bytes],
     codes: List[bytes],
     fork=None,
+    fork_factory=None,
 ):
     """Verify the witness, execute the block against it, and verify the post
     state root. Returns the BlockExecutionResult plus the computed post root.
-    Raises StatelessError / BlockError on any failure."""
+    Raises StatelessError / BlockError on any failure.
+
+    `fork_factory(state) -> Fork` builds the fork AGAINST THE WITNESS-BACKED
+    STATE (a PragueFork must write its EIP-2935 history slots into the
+    partial trie, where they are part of the post root); a prebuilt `fork`
+    instance is accepted for forks that own no state (FrontierFork preloaded
+    with authenticated ancestor hashes)."""
     from phant_tpu.blockchain.chain import Blockchain, BlockError
 
     if not verify_witness_nodes(pre_state_root, nodes):
         raise StatelessError("witness rejected: not a subtree of preStateRoot")
     state = WitnessStateDB(pre_state_root, nodes, codes)
+    if fork is None and fork_factory is not None:
+        fork = fork_factory(state)
     chain = Blockchain(
         chain_id, state, parent_header, fork=fork, verify_state_root=True
     )

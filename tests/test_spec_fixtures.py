@@ -7,7 +7,7 @@ from pathlib import Path
 import pytest
 
 from phant_tpu.spec.fixtures import walk_fixtures
-from phant_tpu.spec.runner import run_fixture
+from phant_tpu.spec.runner import run_fixture, run_fixture_stateless
 
 FIXTURES = Path(__file__).parent / "fixtures"
 
@@ -19,6 +19,16 @@ ALL = [(p.name, fx) for p, fx in walk_fixtures(FIXTURES)]
 )
 def test_spec_fixture(fixture, evm_backend):
     run_fixture(fixture)
+
+
+@pytest.mark.parametrize(
+    "fixture", [fx for _, fx in ALL], ids=[f"{n}::{fx.name}" for n, fx in ALL]
+)
+def test_spec_fixture_stateless(fixture):
+    """The same oracle through `execute_stateless`: every block re-executed
+    from only a witness of its pre-state (the flagship product path,
+    SURVEY §4 extended to the stateless subsystem)."""
+    run_fixture_stateless(fixture)
 
 
 def test_fixture_count():

@@ -460,3 +460,111 @@ def test_stateless_eip158_zero_tip_coinbase_cleanup():
         CHAIN_ID, parent, block, pre_root, nodes, []
     )
     assert computed_root == post_root
+
+
+def test_stateless_blockhash_depth2_via_handler():
+    """BLOCKHASH at ancestor depth 2 during stateless execution must serve
+    the authenticated witness header chain (round 3: headers beyond [0]
+    were previously ignored and deep BLOCKHASH silently read zero)."""
+    sender, accounts = _pre_accounts()
+    bh_contract = b"\xbb" * 20
+    # PUSH1 1 BLOCKHASH PUSH1 0 SSTORE STOP — stores block 1's hash
+    bh_code = bytes.fromhex("60014060005500")
+    accounts[bh_contract] = Account(nonce=1, code=bh_code)
+
+    full = StateDB({a: acct.copy() for a, acct in accounts.items()})
+    builder = Blockchain(CHAIN_ID, full, make_genesis_parent_header(),
+                         verify_state_root=False)
+    headers = [make_genesis_parent_header()]
+    from phant_tpu.types.receipt import logs_bloom as _bloom
+
+    for n in (1, 2):  # two empty blocks so block 3 reads depth-2 history
+        base_fee = calculate_base_fee(
+            headers[-1].gas_limit, headers[-1].gas_used, headers[-1].base_fee_per_gas
+        )
+        h = BlockHeader(
+            parent_hash=headers[-1].hash(), fee_recipient=COINBASE,
+            state_root=full.state_root(), transactions_root=ordered_trie_root([]),
+            receipts_root=ordered_trie_root([]), logs_bloom=_bloom([]),
+            block_number=n, gas_limit=headers[-1].gas_limit, gas_used=0,
+            timestamp=headers[-1].timestamp + 12, base_fee_per_gas=base_fee,
+            withdrawals_root=EMPTY_TRIE_ROOT,
+        )
+        builder.run_block(Block(header=h, transactions=(), withdrawals=()))
+        headers.append(h)
+
+    signer = TxSigner(CHAIN_ID)
+    base_fee = calculate_base_fee(
+        headers[-1].gas_limit, headers[-1].gas_used, headers[-1].base_fee_per_gas
+    )
+    tx = signer.sign(
+        LegacyTx(nonce=0, gas_price=base_fee + 100, gas_limit=100_000,
+                 to=bh_contract, value=0, data=b"", v=37, r=0, s=0),
+        SENDER_KEY,
+    )
+    draft = BlockHeader(
+        parent_hash=headers[-1].hash(), fee_recipient=COINBASE, block_number=3,
+        gas_limit=headers[-1].gas_limit, timestamp=headers[-1].timestamp + 12,
+        base_fee_per_gas=base_fee, withdrawals_root=EMPTY_TRIE_ROOT,
+    )
+    result = builder.apply_body(
+        Block(header=draft, transactions=(tx,), withdrawals=())
+    )
+    post_root = full.state_root()
+    header3 = BlockHeader(
+        parent_hash=headers[-1].hash(), fee_recipient=COINBASE,
+        state_root=post_root,
+        transactions_root=ordered_trie_root([tx.encode()]),
+        receipts_root=ordered_trie_root([r.encode() for r in result.receipts]),
+        logs_bloom=result.logs_bloom, block_number=3,
+        gas_limit=headers[-1].gas_limit, gas_used=result.gas_used,
+        timestamp=headers[-1].timestamp + 12, base_fee_per_gas=base_fee,
+        withdrawals_root=EMPTY_TRIE_ROOT,
+    )
+    block3 = Block(header=header3, transactions=(tx,), withdrawals=())
+    # sanity: the full-state run really read a nonzero depth-2 hash
+    want = int.from_bytes(headers[1].hash(), "big")
+    assert full.get_storage(bh_contract, 0) == want and want != 0
+
+    pre_root, nodes = _full_witness(accounts)
+    chain = Blockchain(CHAIN_ID, StateDB(), headers[-1], verify_state_root=False)
+    witness_json = {
+        "headers": [bytes_to_hex(h.encode()) for h in reversed(headers)],
+        "preStateRoot": bytes_to_hex(pre_root),
+        "state": [bytes_to_hex(n) for n in nodes],
+        "codes": [bytes_to_hex(bh_code)],
+    }
+    request = {
+        "jsonrpc": "2.0", "id": 7,
+        "method": "engine_executeStatelessPayloadV1",
+        "params": [_payload_json(block3), witness_json],
+    }
+    _status, body = handle_request(chain, request)
+    assert body["result"]["status"] == "VALID", body
+    assert body["result"]["stateRoot"] == bytes_to_hex(post_root)
+
+    # missing ancestor header: BLOCKHASH reads zero -> post root mismatch
+    # -> INVALID (never a silently wrong VALID)
+    short = {**witness_json, "headers": witness_json["headers"][:1]}
+    _s, body2 = handle_request(
+        chain, {**request, "params": [_payload_json(block3), short]}
+    )
+    assert body2["result"]["status"] == "INVALID"
+
+    # unchained (forged) ancestor header: rejected by the linkage check
+    from dataclasses import replace as _replace
+
+    fake = _replace(headers[1], extra_data=b"evil")
+    forged = {
+        **witness_json,
+        "headers": [
+            witness_json["headers"][0],
+            bytes_to_hex(fake.encode()),
+            witness_json["headers"][2],
+        ],
+    }
+    _s, body3 = handle_request(
+        chain, {**request, "params": [_payload_json(block3), forged]}
+    )
+    assert body3["result"]["status"] == "INVALID"
+    assert "chain" in body3["result"]["validationError"]
