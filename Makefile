@@ -10,3 +10,12 @@ check-fast:
 
 native:
 	python -c "from phant_tpu.utils.native import build_native; print(build_native(verbose=True))"
+
+# ASan+UBSan run over the native runtime (known-answer vectors + RLP
+# scanner fuzz + ecrecover garbage inputs); SURVEY §5 sanitizers slot.
+sanitize:
+	mkdir -p build
+	g++ -std=c++17 -O1 -g -fsanitize=address,undefined -fno-sanitize-recover=all \
+	  -Wall -Werror -o build/native_selftest \
+	  native/keccak.cc native/packer.cc native/secp256k1.cc native/selftest.cc
+	./build/native_selftest

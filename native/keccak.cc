@@ -80,7 +80,9 @@ void keccak256_one(const uint8_t* in, size_t len, uint8_t* out) {
   // final (padded) block
   uint8_t block[kRate];
   std::memset(block, 0, sizeof(block));
-  std::memcpy(block, in, len);
+  if (len != 0) {  // memcpy from a null `in` is UB even at length 0
+    std::memcpy(block, in, len);
+  }
   block[len] ^= 0x01;
   block[kRate - 1] ^= 0x80;
   for (size_t i = 0; i < kRate / 8; ++i) {

@@ -142,7 +142,39 @@ class Blockchain:
         def dispatch(span):
             s, e = span
             txs = [tx for b in blocks[s:e] for tx in b.transactions]
-            return self.signer.recover_senders_async(txs)
+            try:
+                return self.signer.recover_senders_async(txs)
+            except Exception as exc:  # staging onto a dead device can raise
+                # synchronously; defer to resolve() so the CPU fallback
+                # covers dispatch-time failures too
+                def failed(e=exc):
+                    raise e
+
+                return failed
+
+        def resolve(span, handle):
+            """Materialize a window's senders; a device failure mid-replay
+            (tunnel drop, OOM, preemption) degrades to the CPU batch for
+            the window instead of sinking the import — the reference has
+            no device to lose (its crypto is always in-process,
+            src/crypto/ecdsa.zig); fault tolerance here is the cost of the
+            offload. The fallback pins THIS call to the CPU path instead of
+            flipping the process-global backend (which would race the
+            threaded Engine API server)."""
+            try:
+                return handle()
+            except Exception:
+                import logging
+
+                logging.getLogger("phant.chain").warning(
+                    "device sender-recovery failed for blocks %s-%s; "
+                    "recovering on CPU",
+                    span[0],
+                    span[1] - 1,
+                    exc_info=True,
+                )
+                txs = [tx for b in blocks[span[0] : span[1]] for tx in b.transactions]
+                return self.signer.recover_senders_async(txs, force_cpu=True)()
 
         pending: List = []
         next_span = 0
@@ -151,7 +183,7 @@ class Blockchain:
             next_span += 1
 
         for si, (s, e) in enumerate(spans):
-            senders_flat = pending.pop(0)()
+            senders_flat = resolve(spans[si], pending.pop(0))
             if next_span < len(spans):  # keep the device one window ahead
                 pending.append(dispatch(spans[next_span]))
                 next_span += 1

@@ -404,12 +404,22 @@ def execute_stateless_payload_v1_handler(
                     )
         else:
             parent = blockchain.parent_header
-        if "preStateRoot" in witness_json:
-            pre_root = hex_to_hash(witness_json["preStateRoot"])
-        else:
-            pre_root = parent.state_root
-        nodes = [hex_to_bytes(n) for n in witness_json.get("state", [])]
-        codes = [hex_to_bytes(c) for c in witness_json.get("codes", [])]
+        try:
+            if "preStateRoot" in witness_json:
+                pre_root = hex_to_hash(witness_json["preStateRoot"])
+            else:
+                pre_root = parent.state_root
+            nodes = [hex_to_bytes(n) for n in witness_json.get("state", [])]
+            codes = [hex_to_bytes(c) for c in witness_json.get("codes", [])]
+        except (ValueError, TypeError) as e:
+            # same contract as malformed headers: a bad witness is an
+            # INVALID payload status, not a JSON-RPC protocol error
+            return StatelessPayloadStatusV1(
+                status="INVALID",
+                state_root=zero,
+                receipt_root=zero,
+                validator_error=f"witness does not decode: {e}",
+            )
         # fork selection mirrors the node's own (fork_for over the chain
         # config), but the instance binds to the STATELESS state: the node's
         # resident fork may be bound to its resident StateDB (PragueFork

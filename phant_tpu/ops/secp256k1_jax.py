@@ -109,27 +109,43 @@ def _carry_unrolled(cols: jnp.ndarray, width: int) -> jnp.ndarray:
     return jnp.stack(out, axis=-1), carry
 
 
+def _pad_cols(x: jnp.ndarray, left: int, width: int) -> jnp.ndarray:
+    """Place x's columns at offset `left` in a width-`width` row (static
+    shift = concatenation, an elementwise-fusable op — never a scatter)."""
+    right = width - left - x.shape[-1]
+    pad = [(0, 0)] * (x.ndim - 1) + [(left, right)]
+    return jnp.pad(x, pad)
+
+
 def _mul_wide(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
-    """(B,16) x (B,16) -> (B,32) full 512-bit product."""
+    """(B,16) x (B,16) -> (B,32) full 512-bit product.
+
+    Schoolbook columns are accumulated with STATIC-shift pads + adds
+    instead of `.at[].add` scatters: XLA lowers scatters to slow serialized
+    updates on TPU, while pad+add fuses into the elementwise graph. Column
+    sums stay < 2^21 (16 lo + 16 hi contributions of < 2^16), so uint32
+    accumulation is exact."""
     cols = jnp.zeros(a.shape[:-1] + (33,), jnp.uint32)
     for i in range(LIMBS):
         prod = a[..., i : i + 1] * b  # < 2^32, exact in uint32
-        cols = cols.at[..., i : i + LIMBS].add(prod & MASK16)
-        cols = cols.at[..., i + 1 : i + 1 + LIMBS].add(prod >> 16)
+        cols = cols + _pad_cols(prod & MASK16, i, 33)
+        cols = cols + _pad_cols(prod >> 16, i + 1, 33)
     limbs, carry = _carry_unrolled(cols, 32)
     return limbs  # product < 2^512 so the final carry is 0
 
 
 def _mul_const(h: jnp.ndarray, k_limbs: np.ndarray) -> jnp.ndarray:
-    """(B,w) * constant (k,) -> (B, w+k) exact product."""
+    """(B,w) * constant (k,) -> (B, w+k) exact product (pad+add columns,
+    same rationale as _mul_wide)."""
     w = h.shape[-1]
     k = len(k_limbs)
     kk = jnp.asarray(k_limbs)
-    cols = jnp.zeros(h.shape[:-1] + (w + k + 1,), jnp.uint32)
+    width = w + k + 1
+    cols = jnp.zeros(h.shape[:-1] + (width,), jnp.uint32)
     for i in range(w):
         prod = h[..., i : i + 1] * kk
-        cols = cols.at[..., i : i + k].add(prod & MASK16)
-        cols = cols.at[..., i + 1 : i + 1 + k].add(prod >> 16)
+        cols = cols + _pad_cols(prod & MASK16, i, width)
+        cols = cols + _pad_cols(prod >> 16, i + 1, width)
     limbs, _ = _carry_unrolled(cols, w + k)
     return limbs
 

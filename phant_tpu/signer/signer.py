@@ -128,7 +128,7 @@ class TxSigner:
             raise SignatureError(f"unrecoverable signature at tx index {bad[0]}")
         return out
 
-    def recover_senders_async(self, txs):
+    def recover_senders_async(self, txs, force_cpu: bool = False):
         """Dispatch sender recovery and return `resolve() -> [address|None]`
         (None = invalid signature; the error is raised by whoever consumes
         the block, keeping prefetch failures attributed to the right block).
@@ -139,12 +139,17 @@ class TxSigner:
         even on `--crypto_backend=tpu` — a single real block's ~8-200 txs
         must never pay tunnel RTT serially (round-2 lesson: the flag made
         replay 45x slower). Cross-block prefetch (chain.run_blocks)
-        concatenates many blocks' txs to clear the floor."""
+        concatenates many blocks' txs to clear the floor. `force_cpu`
+        pins this call to the CPU path WITHOUT touching the process-global
+        backend (the device-loss fallback must not race concurrent
+        requests)."""
         from phant_tpu.backend import crypto_backend, jax_device_ok
 
         if not txs:
             return lambda: []
-        tpu_ok = crypto_backend() == "tpu" and jax_device_ok()
+        tpu_ok = (
+            not force_cpu and crypto_backend() == "tpu" and jax_device_ok()
+        )
         use_tpu = tpu_ok and len(txs) >= _min_device_ecrecover()
         native = None
         if not use_tpu:

@@ -149,6 +149,15 @@ def run_fixture_stateless(fixture: Fixture) -> None:
     from phant_tpu.blockchain.fork import FrontierFork
     from phant_tpu.stateless import StatelessError, execute_stateless
 
+    if any(n in fixture.network.lower() for n in ("prague", "osaka")):
+        # Prague-family blocks write EIP-2935 history slots into the post
+        # root; the runner would need a chainspec-derived fork_for config
+        # (as the engine handler uses) — fail loudly rather than mis-root
+        raise FixtureFailure(
+            f"{fixture.name}: stateless runner has no fork config for "
+            f"network {fixture.network!r}"
+        )
+
     state = StateDB({addr: acct.copy() for addr, acct in fixture.pre.items()})
     genesis = Block.decode(fixture.genesis_rlp)
     shadow = Blockchain(

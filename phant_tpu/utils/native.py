@@ -26,7 +26,9 @@ _load_failed = False
 
 
 def _sources() -> List[Path]:
-    return sorted(_NATIVE_DIR.glob("*.cc"))
+    # selftest.cc is the standalone sanitizer harness (`make sanitize`),
+    # not part of the shared library
+    return sorted(p for p in _NATIVE_DIR.glob("*.cc") if p.name != "selftest.cc")
 
 
 def _needs_rebuild() -> bool:

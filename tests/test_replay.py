@@ -74,3 +74,34 @@ def test_run_blocks_cpu_path(small_chain):
     results = chain.run_blocks(blocks)
     assert len(results) == len(blocks)
     assert chain.parent_header == blocks[-1].header
+
+
+def test_run_blocks_survives_device_loss(small_chain, monkeypatch):
+    """Fault injection (SURVEY §5): the device dying mid-replay (tunnel
+    drop / preemption) must degrade to CPU recovery, not sink the import."""
+    import phant_tpu.ops.secp256k1_jax as secp_jax
+
+    genesis, blocks, fresh_state = small_chain
+    monkeypatch.setenv("PHANT_TPU_PREFETCH_SIGS", "8")
+
+    calls = {"n": 0}
+    real = secp_jax.ecrecover_batch_async
+
+    def flaky(*args, **kwargs):
+        calls["n"] += 1
+        if calls["n"] == 2:  # second window's dispatch resolves to a crash
+            return lambda: (_ for _ in ()).throw(RuntimeError("device lost"))
+        if calls["n"] == 3:  # third window dies while STAGING the dispatch
+            raise RuntimeError("device lost at dispatch")
+        return real(*args, **kwargs)
+
+    monkeypatch.setattr(secp_jax, "ecrecover_batch_async", flaky)
+    set_crypto_backend("tpu")
+    try:
+        chain = _fresh_chain(genesis, fresh_state)
+        results = chain.run_blocks(blocks)
+    finally:
+        set_crypto_backend("cpu")
+    assert len(results) == len(blocks)
+    assert chain.parent_header == blocks[-1].header
+    assert calls["n"] >= 2  # the device path was genuinely exercised + failed
