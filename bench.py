@@ -409,6 +409,17 @@ def _build_replay_chain(n_blocks: int, txs_per_block: int):
         withdrawals_root=EMPTY_TRIE_ROOT,
     )
 
+    def fresh_state() -> StateDB:
+        return StateDB({a: acct.copy() for a, acct in genesis_accounts.items()})
+
+    # build blocks by EXECUTING them on a builder chain, so every header
+    # carries its real post-state root (the replay can then be benchmarked
+    # with full state-root verification — a check the reference client
+    # TODO-disables entirely, src/blockchain/blockchain.zig:83-85)
+    from phant_tpu.blockchain.chain import Blockchain
+
+    builder_state = fresh_state()
+    builder = Blockchain(chain_id, builder_state, genesis, verify_state_root=False)
     blocks = []
     parent = genesis
     for b in range(1, n_blocks + 1):
@@ -439,7 +450,7 @@ def _build_replay_chain(n_blocks: int, txs_per_block: int):
             )
             for i in range(len(txs))
         ]
-        header = BlockHeader(
+        draft = BlockHeader(
             parent_hash=parent.hash(),
             block_number=b,
             gas_limit=gas_limit,
@@ -451,11 +462,13 @@ def _build_replay_chain(n_blocks: int, txs_per_block: int):
             withdrawals_root=EMPTY_TRIE_ROOT,
             logs_bloom=logs_bloom([]),
         )
+        builder.apply_body(Block(header=draft, transactions=tuple(txs), withdrawals=()))
+        from dataclasses import replace
+
+        header = replace(draft, state_root=builder_state.state_root())
+        builder.parent_header = header
         blocks.append(Block(header=header, transactions=tuple(txs), withdrawals=()))
         parent = header
-
-    def fresh_state() -> StateDB:
-        return StateDB({a: acct.copy() for a, acct in genesis_accounts.items()})
 
     return genesis, blocks, fresh_state
 
@@ -478,10 +491,10 @@ def bench_replay(platform: str) -> dict:
         if native_available():
             set_evm_backend("native")
 
-        def replay(backend: str) -> float:
+        def replay(backend: str, verify_root: bool = False) -> float:
             set_crypto_backend(backend)
             chain = Blockchain(
-                1, fresh_state(), genesis, verify_state_root=False
+                1, fresh_state(), genesis, verify_state_root=verify_root
             )
             t0 = time.perf_counter()
             # run_blocks pipelines device sender recovery across blocks on
@@ -495,6 +508,13 @@ def bench_replay(platform: str) -> dict:
         out["replay_cpu_blocks_per_sec"] = round(n_blocks / cpu_s, 1)
         tpu_s = replay("tpu")
         out["replay_tpu_blocks_per_sec"] = round(n_blocks / tpu_s, 1)
+        # full validation INCLUDING per-block state-root verification over
+        # the incremental StateDB trie — the check the reference client
+        # TODO-disables (src/blockchain/blockchain.zig:83-85)
+        sr_s = replay("cpu", verify_root=True)
+        out["replay_stateroot_cpu_blocks_per_sec"] = round(n_blocks / sr_s, 1)
+        sr_t = replay("tpu", verify_root=True)
+        out["replay_stateroot_tpu_blocks_per_sec"] = round(n_blocks / sr_t, 1)
         out["replay_blocks"] = n_blocks
         out["replay_txs_per_block"] = txs_per_block
         return out

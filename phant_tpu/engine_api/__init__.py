@@ -411,9 +411,10 @@ def execute_stateless_payload_v1_handler(
                 pre_root = parent.state_root
             nodes = [hex_to_bytes(n) for n in witness_json.get("state", [])]
             codes = [hex_to_bytes(c) for c in witness_json.get("codes", [])]
-        except (ValueError, TypeError) as e:
+        except (ValueError, TypeError, AttributeError) as e:
             # same contract as malformed headers: a bad witness is an
             # INVALID payload status, not a JSON-RPC protocol error
+            # (AttributeError: non-string JSON entries hit str methods)
             return StatelessPayloadStatusV1(
                 status="INVALID",
                 state_root=zero,

@@ -93,19 +93,34 @@ def _common_prefix_len(a: Sequence[int], b: Sequence[int]) -> int:
     return n
 
 
-def _insert(node: Optional[Node], path: Tuple[int, ...], value: bytes) -> Node:
+def _noop_evict(node: Node) -> None:
+    pass
+
+
+def _insert(
+    node: Optional[Node], path: Tuple[int, ...], value: bytes, evict=_noop_evict
+) -> Node:
     """Insert (path, value); mirrors the reference's recursive insertNode
-    (reference: src/mpt/mpt.zig:47-119) but returns fresh subtree roots."""
+    (reference: src/mpt/mpt.zig:47-119) but returns fresh subtree roots.
+
+    `evict(node)` is called for every node whose cached encoding becomes
+    stale — both MUTATED nodes (their encoding changes) and DISCARDED nodes
+    (their id may be reused by a new object, so a live cache entry would be
+    a use-after-free-style stale hit). Untouched subtrees keep their cache
+    entries, making repeated root computation O(dirty-paths), not O(trie).
+    """
     if node is None:
         return LeafNode(path, value)
 
     if isinstance(node, LeafNode):
         if node.path == path:
+            evict(node)  # mutated
             node.value = value
             return node
         common = _common_prefix_len(node.path, path)
         branch = BranchNode()
         old_rest, new_rest = node.path[common:], path[common:]
+        evict(node)  # discarded (replaced by the split structure)
         if not old_rest:
             branch.value = node.value
         else:
@@ -121,9 +136,11 @@ def _insert(node: Optional[Node], path: Tuple[int, ...], value: bytes) -> Node:
     if isinstance(node, ExtensionNode):
         common = _common_prefix_len(node.path, path)
         if common == len(node.path):
-            node.child = _insert(node.child, path[common:], value)
+            evict(node)  # child set below: encoding changes
+            node.child = _insert(node.child, path[common:], value, evict)
             return node
         # split the extension
+        evict(node)  # discarded
         branch = BranchNode()
         ext_rest = node.path[common:]
         # the shortened old subtree hangs under ext_rest[0]
@@ -141,10 +158,11 @@ def _insert(node: Optional[Node], path: Tuple[int, ...], value: bytes) -> Node:
         return branch
 
     # BranchNode
+    evict(node)  # value or child slot changes either way
     if not path:
         node.value = value
         return node
-    node.children[path[0]] = _insert(node.children[path[0]], path[1:], value)
+    node.children[path[0]] = _insert(node.children[path[0]], path[1:], value, evict)
     return node
 
 
@@ -161,11 +179,13 @@ class _Unresolved(Exception):
     possible on PartialTrie, where unwitnessed subtrees are HashNodes)."""
 
 
-def _merge_into(nibble_prefix: Tuple[int, ...], child: Node) -> Node:
+def _merge_into(nibble_prefix: Tuple[int, ...], child: Node, evict=_noop_evict) -> Node:
     """Prepend `nibble_prefix` to a child that lost its parent branch/ext."""
     if isinstance(child, LeafNode):
+        evict(child)  # discarded: replaced by the merged leaf
         return LeafNode(nibble_prefix + child.path, child.value)
     if isinstance(child, ExtensionNode):
+        evict(child)  # discarded: replaced by the merged extension
         return ExtensionNode(nibble_prefix + child.path, child.child)
     if isinstance(child, BranchNode):
         if not nibble_prefix:
@@ -176,29 +196,38 @@ def _merge_into(nibble_prefix: Tuple[int, ...], child: Node) -> Node:
     raise _Unresolved()
 
 
-def _collapse_branch(node: BranchNode) -> Optional[Node]:
+def _collapse_branch(node: BranchNode, evict=_noop_evict) -> Optional[Node]:
     """Re-normalize a branch after a child was deleted."""
     live = [(i, c) for i, c in enumerate(node.children) if c is not None]
     if node.value is not None:
         if not live:
+            evict(node)  # discarded
             return LeafNode((), node.value)
         return node
     if not live:
         return None
     if len(live) == 1:
         i, child = live[0]
-        return _merge_into((i,), child)
+        evict(node)  # discarded (folded into the merged child)
+        return _merge_into((i,), child, evict)
     return node
 
 
-def _delete(node: Optional[Node], path: Tuple[int, ...]) -> Optional[Node]:
+def _delete(
+    node: Optional[Node], path: Tuple[int, ...], evict=_noop_evict
+) -> Optional[Node]:
     """Remove `path`; returns the re-normalized subtree (None = empty).
-    Missing keys are a no-op (matching geth's trie delete semantics)."""
+    Missing keys are a no-op (matching geth's trie delete semantics).
+    `evict` receives every node whose cached encoding goes stale (mutated
+    ancestors and discarded/collapsed nodes) — see _insert."""
     if node is None:
         return None
 
     if isinstance(node, LeafNode):
-        return None if node.path == tuple(path) else node
+        if node.path == tuple(path):
+            evict(node)  # discarded
+            return None
+        return node
 
     if not isinstance(node, (ExtensionNode, BranchNode)):
         # opaque HashNode (PartialTrie): the delete path crosses an
@@ -209,30 +238,36 @@ def _delete(node: Optional[Node], path: Tuple[int, ...]) -> Optional[Node]:
         n = len(node.path)
         if tuple(path[:n]) != node.path:
             return node  # key absent
-        new_child = _delete(node.child, tuple(path[n:]))
+        # anything below may mutate in place; this encoding goes stale
+        # either way (eviction on a no-op absent-key delete is harmless)
+        evict(node)
+        new_child = _delete(node.child, tuple(path[n:]), evict)
         if new_child is node.child:
-            return node  # absent below: no structural change
+            return node  # absent below or mutated in place
         if new_child is None:
+            evict(node)  # discarded
             return None
-        return _merge_into(node.path, new_child)
+        return _merge_into(node.path, new_child, evict)
 
     # BranchNode
     if not path:
         if node.value is None:
             return node  # key absent
+        evict(node)
         node.value = None
-        return _collapse_branch(node)
+        return _collapse_branch(node, evict)
     i = path[0]
     old_child = node.children[i]
     if old_child is None:
         return node  # key absent
-    new_child = _delete(old_child, tuple(path[1:]))
+    evict(node)  # see extension case: stale either way
+    new_child = _delete(old_child, tuple(path[1:]), evict)
     if new_child is old_child:
-        return node  # no structural change
+        return node  # absent below or mutated in place
     node.children[i] = new_child
     if new_child is not None:
         return node
-    return _collapse_branch(node)
+    return _collapse_branch(node, evict)
 
 
 class Trie:
@@ -243,29 +278,34 @@ class Trie:
         # upper bound on leaf count (overwrites double-count); used only as
         # the device-dispatch size heuristic in trie_root_hash
         self.approx_size = 0
-        # node-id -> (structure, encoding) memo; valid only between mutations
-        # (cleared on put; ids are stable while the trie is read-only).
+        # node-id -> (structure, encoding) memo with PER-PATH invalidation:
+        # put/delete evict exactly the mutated/discarded nodes (and any
+        # freed object is evicted before its id can be reused), so repeated
+        # roots after K updates re-encode only the K dirty paths.
         self._enc_cache: Dict[int, Tuple[rlp.RLPItem, bytes]] = {}
         # mutation epoch: bumped on every put/delete; the device HashPlan
         # cache (phant_tpu/ops/mpt_jax.py trie_root_device) is keyed on it
         self._epoch = 0
 
+    def _evict(self, node: Node) -> None:
+        self._enc_cache.pop(id(node), None)
+
     def put(self, key: bytes, value: bytes) -> None:
         if not value:  # empty value = delete (geth trie semantics)
             self.delete(key)
             return
-        self._enc_cache.clear()
         self._epoch += 1
         self.approx_size += 1
-        self.root = _insert(self.root, bytes_to_nibbles(key), value)
+        # per-path cache eviction: untouched subtrees keep their encodings,
+        # so a root after K updates re-encodes O(K * depth) nodes only
+        self.root = _insert(self.root, bytes_to_nibbles(key), value, self._evict)
 
     def delete(self, key: bytes) -> None:
         """Remove `key` with full branch-collapse/extension-merge
         re-normalization (no-op when absent)."""
-        self._enc_cache.clear()
         self._epoch += 1
         self.approx_size = max(self.approx_size - 1, 0)
-        self.root = _delete(self.root, bytes_to_nibbles(key))
+        self.root = _delete(self.root, bytes_to_nibbles(key), self._evict)
 
     def get(self, key: bytes) -> Optional[bytes]:
         node, path = self.root, bytes_to_nibbles(key)

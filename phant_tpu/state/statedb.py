@@ -15,7 +15,6 @@ from typing import Dict, List, Optional, Set, Tuple
 
 from phant_tpu.types.account import Account
 from phant_tpu.types.receipt import Log
-from phant_tpu.state.root import state_root as _state_root
 
 Address = bytes  # 20 bytes
 
@@ -25,6 +24,22 @@ class StateDB:
         self.accounts: Dict[Address, Account] = accounts or {}
         # undo log: list of (tag, payload) entries, newest last
         self._journal: List[Tuple] = []
+        # incremental state-root cache: a retained secure trie plus the set
+        # of addresses mutated since it was last synced. state_root() then
+        # re-leafs only dirty accounts instead of rebuilding the whole trie
+        # per block (the reference never computes state roots at all —
+        # src/blockchain/blockchain.zig:83-85 — so this has no analog).
+        # Journal rollbacks restore values of exactly the addresses the
+        # forward mutations already marked dirty, so the set stays a
+        # superset of every divergence from the synced trie.
+        self._root_trie = None
+        self._root_dirty: Set[Address] = set()
+        # per-account retained storage tries (same per-path scheme): keyed
+        # by the Account OBJECT so delete+recreate (journal-rollback-safe
+        # identity) invalidates naturally; dirty slots accumulate in
+        # set_storage/revert_to
+        self._storage_tries: Dict[Address, Tuple[Account, object]] = {}
+        self._storage_dirty: Dict[Address, Set[int]] = {}
         # --- per-transaction scope ---
         self._tx_original: Dict[Tuple[Address, int], int] = {}
         self.accessed_addresses: Set[Address] = set()
@@ -75,14 +90,20 @@ class StateDB:
         return len(self._journal)
 
     def revert_to(self, mark: int) -> None:
+        # every state-restoring branch re-marks the address (and slot) in
+        # the incremental-root dirty sets: a rollback AFTER a state_root()
+        # call (e.g. a block rejected on state-root mismatch) must not
+        # leave the retained trie stuck on the rejected state
         while len(self._journal) > mark:
             tag, *payload = self._journal.pop()
             if tag == "balance":
                 addr, old = payload
                 self.accounts[addr].balance = old
+                self._root_dirty.add(addr)
             elif tag == "nonce":
                 addr, old = payload
                 self.accounts[addr].nonce = old
+                self._root_dirty.add(addr)
             elif tag == "storage":
                 addr, slot, old = payload
                 acct = self.accounts[addr]
@@ -90,15 +111,20 @@ class StateDB:
                     acct.storage.pop(slot, None)
                 else:
                     acct.storage[slot] = old
+                self._root_dirty.add(addr)
+                self._storage_dirty.setdefault(addr, set()).add(slot)
             elif tag == "code":
                 addr, old = payload
                 self.accounts[addr].code = old
+                self._root_dirty.add(addr)
             elif tag == "create_account":
                 (addr,) = payload
                 self.accounts.pop(addr, None)
+                self._root_dirty.add(addr)
             elif tag == "delete_account":
                 addr, acct = payload
                 self.accounts[addr] = acct
+                self._root_dirty.add(addr)
             elif tag == "warm_addr":
                 (addr,) = payload
                 self.accessed_addresses.discard(addr)
@@ -141,6 +167,7 @@ class StateDB:
             acct = Account()
             self.accounts[addr] = acct
             self._journal.append(("create_account", addr))
+            self._root_dirty.add(addr)
         return acct
 
     def create_account(self, addr: Address) -> Account:
@@ -156,6 +183,7 @@ class StateDB:
         acct = self.accounts.pop(addr, None)
         if acct is not None:
             self._journal.append(("delete_account", addr, acct))
+            self._root_dirty.add(addr)
 
     def is_empty(self, addr: Address) -> bool:
         acct = self.accounts.get(addr)
@@ -172,6 +200,7 @@ class StateDB:
     def set_balance(self, addr: Address, value: int) -> None:
         acct = self._get_or_create(addr)
         self._journal.append(("balance", addr, acct.balance))
+        self._root_dirty.add(addr)
         acct.balance = value
 
     def add_balance(self, addr: Address, delta: int) -> None:
@@ -190,6 +219,7 @@ class StateDB:
     def set_nonce(self, addr: Address, value: int) -> None:
         acct = self._get_or_create(addr)
         self._journal.append(("nonce", addr, acct.nonce))
+        self._root_dirty.add(addr)
         acct.nonce = value
 
     def increment_nonce(self, addr: Address) -> None:
@@ -202,6 +232,7 @@ class StateDB:
     def set_code(self, addr: Address, code: bytes) -> None:
         acct = self._get_or_create(addr)
         self._journal.append(("code", addr, acct.code))
+        self._root_dirty.add(addr)
         acct.code = code
 
     # ------------------------------------------------------------------
@@ -219,6 +250,8 @@ class StateDB:
         if key not in self._tx_original:
             self._tx_original[key] = current
         self._journal.append(("storage", addr, slot, current))
+        self._root_dirty.add(addr)
+        self._storage_dirty.setdefault(addr, set()).add(slot)
         if value == 0:
             acct.storage.pop(slot, None)
         else:
@@ -288,8 +321,58 @@ class StateDB:
             if acct is not None and acct.is_empty():
                 self.delete_account(addr)
 
+    def _storage_root_incremental(self, addr: Address, acct: Account) -> bytes:
+        """Storage root via a retained per-account trie: only dirty slots
+        are re-put/deleted. Account-object identity guards delete+recreate
+        (rollback restores the original object, so identity is stable)."""
+        from phant_tpu.crypto.keccak import keccak256
+        from phant_tpu import rlp
+        from phant_tpu.state.root import build_storage_trie
+
+        entry = self._storage_tries.get(addr)
+        if entry is None or entry[0] is not acct:
+            trie = build_storage_trie(acct.storage)
+            self._storage_tries[addr] = (acct, trie)
+            self._storage_dirty.pop(addr, None)
+            return trie.root_hash()
+        trie = entry[1]
+        for slot in self._storage_dirty.pop(addr, ()):
+            value = acct.storage.get(slot, 0)
+            key = keccak256(slot.to_bytes(32, "big"))
+            if value == 0:
+                trie.delete(key)
+            else:
+                trie.put(key, rlp.encode(rlp.encode_uint(value)))
+        return trie.root_hash()
+
     def state_root(self) -> bytes:
-        return _state_root(self.accounts)
+        from phant_tpu.crypto.keccak import keccak256
+        from phant_tpu import rlp
+        from phant_tpu.state.root import build_state_trie
+
+        if self._root_trie is None:
+            self._root_trie = build_state_trie(self.accounts)
+        else:
+            for addr in self._root_dirty:
+                acct = self.accounts.get(addr)
+                key = keccak256(addr)
+                if acct is None or (acct.is_empty() and not acct.storage):
+                    self._root_trie.delete(key)
+                else:
+                    leaf = rlp.encode([
+                        rlp.encode_uint(acct.nonce),
+                        rlp.encode_uint(acct.balance),
+                        self._storage_root_incremental(addr, acct),
+                        acct.code_hash(),
+                    ])
+                    self._root_trie.put(key, leaf)
+        self._root_dirty.clear()
+        # host recursion on purpose, even on --crypto_backend=tpu: the
+        # retained trie re-encodes only dirty paths (per-path enc cache),
+        # which beats shipping a full plan rebuild to the device every
+        # block; the device state-root path serves FULL recomputes (the
+        # stateless witness pipeline), not incremental resident updates
+        return self._root_trie.root_hash()
 
     def copy(self) -> "StateDB":
         return StateDB({a: acct.copy() for a, acct in self.accounts.items()})

@@ -263,7 +263,7 @@ def test_delete_fuzz_against_rebuild():
             v = rng.randbytes(rng.randint(1, 40))
             t.put(k, v)
             d[k] = v
-        if step % 60 == 0:
+        if step % 7 == 0:  # frequent roots: the per-path enc cache must stay coherent
             assert t.root_hash() == _rebuild_root(d), f"divergence at step {step}"
     assert t.root_hash() == _rebuild_root(d)
     for k in list(d):

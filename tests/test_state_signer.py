@@ -177,3 +177,95 @@ def test_recover_rejects_garbage():
         secp256k1.recover_pubkey(b"\x11" * 32, bad_r, 1, 0)
     except SignatureError:
         pass  # acceptable: not on curve
+
+
+def test_incremental_state_root_matches_rebuild():
+    """StateDB.state_root keeps a retained trie synced via a dirty set; it
+    must equal a from-scratch rebuild across mutations, deletions, and
+    journal rollbacks."""
+    import numpy as np
+
+    from phant_tpu.state.root import state_root as rebuild_root
+    from phant_tpu.state.statedb import StateDB
+    from phant_tpu.types.account import Account
+
+    rng = np.random.default_rng(21)
+    db = StateDB(
+        {rng.bytes(20): Account(balance=int(rng.integers(1, 10**12)))
+         for _ in range(50)}
+    )
+    addrs = list(db.accounts)
+    assert db.state_root() == rebuild_root(db.accounts)
+
+    db.begin_block()
+    for i in range(30):
+        a = addrs[int(rng.integers(0, len(addrs)))]
+        db.add_balance(a, 7)
+        db.set_storage(a, int(rng.integers(0, 5)), int(rng.integers(0, 3)))
+    new_addr = rng.bytes(20)
+    db.set_balance(new_addr, 123)
+    db.delete_account(addrs[0])
+    assert db.state_root() == rebuild_root(db.accounts)
+
+    # rollback must bring the incremental root back too
+    db.begin_block()
+    before = db.state_root()
+    db.set_balance(addrs[1], 999)
+    db.delete_account(addrs[2])
+    db.set_storage(addrs[3], 1, 42)
+    db.rollback_block()
+    assert db.state_root() == before == rebuild_root(db.accounts)
+
+
+def test_incremental_root_survives_rollback_after_state_root():
+    """Code-review r3 repro: state_root() mid-block syncs the retained trie
+    to a post-state that the block's rollback then rejects; the rollback
+    must re-mark reverted addresses dirty or every later root is wrong."""
+    import numpy as np
+
+    from phant_tpu.state.root import state_root as rebuild_root
+    from phant_tpu.state.statedb import StateDB
+    from phant_tpu.types.account import Account
+
+    rng = np.random.default_rng(33)
+    db = StateDB(
+        {rng.bytes(20): Account(balance=int(rng.integers(1, 10**12)),
+                                storage={1: 5, 2: 9})
+         for _ in range(20)}
+    )
+    addrs = list(db.accounts)
+    good = db.state_root()
+
+    db.begin_block()
+    db.set_balance(addrs[0], 777)
+    db.set_storage(addrs[1], 2, 0)   # storage deletion
+    db.set_storage(addrs[1], 7, 123)
+    db.delete_account(addrs[2])
+    bad = db.state_root()            # syncs the retained trie mid-block
+    assert bad != good
+    db.rollback_block()              # block rejected (e.g. root mismatch)
+    assert db.state_root() == good == rebuild_root(db.accounts)
+
+
+def test_incremental_storage_root_heavy_account():
+    """Per-account retained storage tries: repeated single-slot writes to a
+    large contract must stay correct across roots, deletion, recreation."""
+    import numpy as np
+
+    from phant_tpu.state.root import state_root as rebuild_root
+    from phant_tpu.state.statedb import StateDB
+    from phant_tpu.types.account import Account
+
+    rng = np.random.default_rng(34)
+    big = rng.bytes(20)
+    db = StateDB({big: Account(code=b"\xfe", storage={i: i + 1 for i in range(200)})})
+    db.state_root()
+    db.begin_block()
+    for step in range(12):
+        db.set_storage(big, int(rng.integers(0, 250)), int(rng.integers(0, 3)))
+        assert db.state_root() == rebuild_root(db.accounts), step
+    # delete + recreate resets storage entirely (object-identity guard)
+    db.delete_account(big)
+    db.create_account(big)
+    db.set_storage(big, 5, 42)
+    assert db.state_root() == rebuild_root(db.accounts)
