@@ -2,19 +2,42 @@
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", "detail"}.
 
-Headline workload (BASELINE.md config #3/#5 shaped): for each synthetic
-block, a multiproof witness (touched accounts of a state trie) is FULLY
-verified — every node keccak256-hashed AND the parent->child hash linkage
-checked, so the witness must form a connected subtree rooted at the block's
-expected state root (a broken path is rejected, not just a missing root).
-The CPU baseline runs the native C++ path (keccak + RLP ref scan via
-ctypes; reference-equivalent scope: src/crypto/hasher.zig +
-src/mpt/mpt.zig). The measured path ships each batch's raw witness bytes to
-the device and runs unpack + pad + hash + link-join + verdict fused on
-device (phant_tpu/ops/witness_jax.py witness_verify_linked), with several
-batches in flight to hide dispatch latency. Timed region is end-to-end per
-batch on both sides: host layout + ref scan, transfer, compute, verdict
-readback.
+TIMING IS SYNC-HONEST (round-3 discovery): on the tunneled `axon` TPU
+backend, `jax.Array.block_until_ready()` can return before the transfer
+and compute have actually happened at large shapes, which silently turned
+earlier rounds' device timings into dispatch-rate measurements. Every
+timed region here therefore ends in a forced host readback (`np.asarray`
+of the real result) — the only reliable sync — and the measured tunnel
+characteristics (upload MB/s, round-trip latency) are reported in
+`detail` so the numbers can be interpreted. On this tunnel the host->
+device path runs at ~20 MB/s (vs ~GB/s for locally attached TPUs), which
+rules out winning any workload whose bytes/op is high; the design answer
+is the memoized witness engine below, whose steady-state traffic is only
+the nodes the previous block actually changed.
+
+Headline workload (BASELINE.md config #3/#5 shaped): a chain of blocks
+over an EVOLVING 65536-leaf state trie (each block reads ~32 accounts —
+hot/cold skewed like mainnet — writes 8, and ships a pre-state multiproof
+witness incl. storage subtrees). Every witness is FULLY verified: every
+node keccak256-hashed AND the parent->child hash linkage checked, so the
+witness must form a connected subtree rooted at the block's expected state
+root. Three verifiers are measured on the SAME timed span:
+
+  * cpu_baseline — the reference-equivalent cold path: per block, batch-
+    keccak every node (native C), scan child refs, check connectivity.
+    No cross-block reuse, exactly the reference's recompute-per-block
+    design (src/crypto/hasher.zig:4-17, src/mpt/mpt.zig:38-119).
+  * headline value — the framework path (`--crypto_backend=tpu`): the
+    memoized WitnessEngine (phant_tpu/ops/witness_engine.py), novel-node
+    hashing batched on device, linkage as vectorized integer joins. Warmed
+    on a chain prefix; the timed span pays only for nodes its blocks
+    actually changed — the architecture the north star names.
+  * engine-cpu (detail) — the same engine hashing on native C: isolates
+    architecture-vs-chip contribution honestly.
+
+The cold fused device kernel (everything incl. RLP ref parsing on device,
+ops/witness_jax.py witness_verify_fused) is also timed honestly — forced
+readback per batch — and reported as detail.device_cold_blocks_per_sec.
 
 Secondary metrics in "detail": state-root recompute p50 latency (BASELINE.md
 metric #2), a 1000-block mainnet replay through the full run_block path
@@ -103,6 +126,118 @@ def build_witnesses(
                     nodes[n] = None
         witnesses.append((root, list(nodes.keys())))
     return witnesses
+
+
+def build_witness_chain(
+    n_blocks: int,
+    trie_size: int = 65536,
+    hot_set: int = 4096,
+    reads: int = 32,
+    writes: int = 8,
+    storage_slots: int = 0,
+    storage_reads_per_block: int = 8,
+    seed: int = 7,
+):
+    """A chain of pre-state witnesses over an EVOLVING trie.
+
+    Each block reads `reads` accounts (75% from a `hot_set`-sized hot set,
+    25% uniform — mainnet access is heavily skewed) and writes `writes` of
+    them (balance bump), so consecutive witnesses share every node except
+    the ones the previous block's writes actually changed. Storage-subtree
+    proofs ride along anchored through a committing account leaf, as in
+    build_witnesses."""
+    from phant_tpu import rlp
+    from phant_tpu.crypto.keccak import keccak256
+    from phant_tpu.mpt.mpt import Trie
+    from phant_tpu.mpt.proof import generate_proof
+
+    rng = np.random.default_rng(seed)
+    storage = Trie()
+    storage_keys = []
+    for _ in range(storage_slots):
+        sk = keccak256(rng.bytes(32))
+        storage.put(sk, rlp.encode(rlp.encode_uint(int.from_bytes(rng.bytes(25), "big") + 1)))
+        storage_keys.append(sk)
+    sroot = storage.root_hash() if storage_slots else None
+
+    def leaf_for(i: int, balance: int) -> bytes:
+        return rlp.encode(
+            [
+                rlp.encode_uint(i % 997),
+                rlp.encode_uint(balance),
+                sroot if (sroot is not None and i % 4 == 0) else bytes(code_salts[i][:32]),
+                bytes(code_salts[i][32:]),
+            ]
+        )
+
+    code_salts = [rng.bytes(64) for _ in range(trie_size)]
+    balances = rng.integers(1, 10**12, size=trie_size).astype(object)
+    trie = Trie()
+    keys = []
+    for i in range(trie_size):
+        key = keccak256(rng.bytes(20))
+        trie.put(key, leaf_for(i, int(balances[i])))
+        keys.append(key)
+
+    chain = []
+    hot_set = min(hot_set, trie_size)
+    for _b in range(n_blocks):
+        hot = rng.choice(hot_set, size=(reads * 3) // 4, replace=False)
+        cold = rng.choice(trie_size, size=reads - len(hot), replace=False)
+        touched = np.unique(np.concatenate([hot, cold]))
+        root = trie.root_hash()
+        nodes: dict = {}
+        if storage_keys:
+            # ensure a storage-root-committing account anchors the subtree
+            anchor = int(rng.integers(0, min(hot_set, trie_size) // 4)) * 4
+            touched = np.unique(np.append(touched, anchor))
+        for i in touched:
+            for n in generate_proof(trie, keys[int(i)]):
+                nodes[n] = None
+        if storage_keys and storage_reads_per_block:
+            sidx = rng.choice(
+                len(storage_keys), size=storage_reads_per_block, replace=False
+            )
+            for i in sidx:
+                for n in generate_proof(storage, storage_keys[int(i)]):
+                    nodes[n] = None
+        chain.append((root, list(nodes.keys())))
+        # apply the block's writes: next block's witness re-ships exactly
+        # the changed paths
+        for i in rng.choice(min(hot_set, trie_size), size=writes, replace=False):
+            balances[i] = int(balances[i]) + 1
+            trie.put(keys[int(i)], leaf_for(int(i), int(balances[i])))
+    return chain
+
+
+def _native_hasher():
+    """Native C batched keccak as a WitnessEngine hasher (None if no lib)."""
+    from phant_tpu.utils.native import load_native
+
+    native = load_native()
+    if native is None:
+        return None
+    return lambda nodes: native.keccak256_batch(nodes)
+
+
+def _tunnel_probe(platform: str) -> dict:
+    """Measured device-link characteristics (upload MB/s, round-trip ms) so
+    the device numbers can be interpreted: a tunneled chip is ~3 orders of
+    magnitude slower to feed than a locally attached one. Reports the SAME
+    measurement the adaptive offload routing used
+    (phant_tpu/backend.py device_link_profile)."""
+    if platform == "cpu":
+        return {}
+    try:
+        from phant_tpu.backend import device_link_profile
+
+        up_bps, rtt = device_link_profile()
+        return {
+            "tunnel_upload_mbps": round(up_bps / 1e6, 1),
+            "tunnel_roundtrip_ms": round(rtt * 1e3, 1),
+        }
+    except Exception as e:
+        return {"tunnel_probe_error": repr(e)[:120]}
 
 
 def verify_cpu(witnesses) -> int:
@@ -208,70 +343,126 @@ def main() -> None:
         witness_verify_fused,
     )
 
-    # mainnet-like shapes (round-2 weak #7): 65536-leaf state trie gives
-    # 5-6 nodes per account path incl. ~532B branch nodes, plus storage
-    # subtree proofs hash-linked through account leaves
-    n_blocks = int(os.environ.get("PHANT_BENCH_BLOCKS", "256"))
-    accounts = int(os.environ.get("PHANT_BENCH_ACCOUNTS", "32"))
+    # mainnet-like shapes (round-2 weak #7): 65536-leaf evolving state trie
+    # gives 5-6 nodes per account path incl. ~532B branch nodes, storage
+    # subtree proofs hash-linked through account leaves, and realistic
+    # consecutive-witness overlap (only written paths change)
+    warm_blocks = int(os.environ.get("PHANT_BENCH_WARM", "256"))
+    span_blocks = int(os.environ.get("PHANT_BENCH_BLOCKS", "256"))
     trie_size = int(os.environ.get("PHANT_BENCH_TRIE", "65536"))
-    witnesses = build_witnesses(
-        n_blocks, accounts, trie_size,
-        storage_slots=4096, storage_reads_per_block=8,
+    chain = build_witness_chain(
+        warm_blocks + span_blocks,
+        trie_size=trie_size,
+        reads=int(os.environ.get("PHANT_BENCH_ACCOUNTS", "32")),
+        writes=8,
+        storage_slots=4096,
+        storage_reads_per_block=8,
     )
-    node_lists = [nodes for _root, nodes in witnesses]
-    roots = roots_to_words([root for root, _nodes in witnesses])
+    warm, span = chain[:warm_blocks], chain[warm_blocks:]
+    node_lists = [nodes for _root, nodes in span]
+    n_blocks = span_blocks
 
-    # --- CPU baseline (best of 3 to shrug off machine noise) ---------------
-    verify_cpu(witnesses[:4])  # warm the native lib
+    # --- CPU baseline: reference-equivalent cold verification --------------
+    verify_cpu(span[:4])  # warm the native lib
     cpu_s = float("inf")
-    for _ in range(3):
+    for _ in range(2):
         t0 = time.perf_counter()
-        ok_cpu = verify_cpu(witnesses)
+        ok_cpu = verify_cpu(span)
         cpu_s = min(cpu_s, time.perf_counter() - t0)
         assert ok_cpu == n_blocks
     cpu_rate = n_blocks / cpu_s
 
-    # --- device path: the fused kernel (on-device RLP ref extraction) ------
-    # host work per batch is just concatenation + a (2, B) uint16 table;
-    # transfers are the witness bytes + 4 bytes/node, nothing else
-    _, meta0 = pack_witness_fused(node_lists, MAX_CHUNKS)
-    pad_nodes = meta0.shape[1]  # stable compiled shapes across batches
-    roots_d = jnp.asarray(roots)
+    # --- framework path: memoized engine behind --crypto_backend=tpu -------
+    from phant_tpu.backend import set_crypto_backend
+    from phant_tpu.ops.witness_engine import WitnessEngine
 
-    def dispatch():
-        """Full per-batch pipeline: blob layout -> transfer -> fused device
-        unpack+hash+ref-parse+link-join+verdict, in flight."""
-        blob, meta16 = pack_witness_fused(
-            node_lists, MAX_CHUNKS, pad_nodes_to=pad_nodes
+    batch = int(os.environ.get("PHANT_BENCH_ENGINE_BATCH", "64"))
+
+    def run_engine(hasher=None, backend=None, eng_batch=None) -> tuple:
+        """Warm on the prefix, then time the span (verdicts are host numpy —
+        the digest readbacks inside intern() make this sync-honest)."""
+        b = eng_batch or batch
+        if backend:
+            set_crypto_backend(backend)
+        try:
+            eng = WitnessEngine(hasher=hasher)
+            for i in range(0, len(warm), b):
+                assert eng.verify_batch(warm[i : i + b]).all()
+            warm_hashed = eng.stats["hashed"]
+            t0 = time.perf_counter()
+            for i in range(0, len(span), b):
+                assert eng.verify_batch(span[i : i + b]).all()
+            dt = time.perf_counter() - t0
+            return dt, eng.stats["hashed"] - warm_hashed, eng.stats
+        finally:
+            if backend:
+                set_crypto_backend("cpu")
+
+    # engine on native C hashing (architecture-only contribution)
+    ecpu_s, novel, _st = run_engine(hasher=_native_hasher())
+    if platform != "cpu":
+        # the product path: --crypto_backend=tpu with adaptive link-aware
+        # routing (ships a novel batch to the chip only when the measured
+        # link says it beats the native hasher)
+        edev_s, novel, rstats = run_engine(backend="tpu")
+        # transparency: the device FORCED on every novel batch, honest sync
+        efrc_s, _n, _s = run_engine(
+            hasher=WitnessEngine._hash_batch_device, eng_batch=256
         )
-        return witness_verify_fused(
-            jnp.asarray(blob),
-            jnp.asarray(meta16),
-            roots_d,
-            max_chunks=MAX_CHUNKS,
-            n_blocks=n_blocks,
-        )
+    else:
+        edev_s, rstats, efrc_s = ecpu_s, {}, None
+    dev_rate = n_blocks / edev_s
 
-    dispatch().block_until_ready()  # compile
-    reps = 24 if platform != "cpu" else 3
-    t0 = time.perf_counter()
-    in_flight = [dispatch() for _ in range(reps)]
-    for out in in_flight:
-        out.block_until_ready()
-    dev_s = (time.perf_counter() - t0) / reps
-    ok_dev = int(np.asarray(in_flight[-1]).sum())
-    assert ok_dev == n_blocks, f"device verified {ok_dev}/{n_blocks}"
+    # --- cold fused device kernel (no memoization), honest sync ------------
+    cold_rate = None
+    if platform != "cpu":
+        _, meta0 = pack_witness_fused(node_lists, MAX_CHUNKS)
+        pad_nodes = meta0.shape[1]
+        roots_d = jnp.asarray(roots_to_words([r for r, _ in span]))
 
-    dev_rate = n_blocks / dev_s
+        def dispatch():
+            blob, meta16 = pack_witness_fused(
+                node_lists, MAX_CHUNKS, pad_nodes_to=pad_nodes
+            )
+            return witness_verify_fused(
+                jnp.asarray(blob),
+                jnp.asarray(meta16),
+                roots_d,
+                max_chunks=MAX_CHUNKS,
+                n_blocks=n_blocks,
+            )
+
+        assert int(np.asarray(dispatch()).sum()) == n_blocks  # compile+check
+        cold_s = float("inf")
+        for _ in range(2):
+            t0 = time.perf_counter()
+            ok_dev = int(np.asarray(dispatch()).sum())  # forced readback
+            cold_s = min(cold_s, time.perf_counter() - t0)
+            assert ok_dev == n_blocks, f"device verified {ok_dev}/{n_blocks}"
+        cold_rate = n_blocks / cold_s
+
     detail = {
         "backend": jax.devices()[0].platform,
+        "timing": "forced-readback",
         "cpu_baseline_blocks_per_sec": round(cpu_rate, 2),
+        "engine_cpu_blocks_per_sec": round(n_blocks / ecpu_s, 2),
+        "novel_nodes_per_block": round(novel / n_blocks, 1) if novel else None,
         "nodes_per_block": round(sum(len(n) for n in node_lists) / n_blocks, 1),
         "witness_bytes_per_block": round(
             sum(len(n) for nl in node_lists for n in nl) / n_blocks
         ),
-        "verification": "linked-multiproof-fused",
+        "verification": "linked-multiproof-memoized",
     }
+    if rstats:
+        detail["routing"] = {
+            "device_batches": rstats.get("device_batches", 0),
+            "native_batches": rstats.get("native_batches", 0),
+        }
+    if efrc_s is not None:
+        detail["engine_tpu_forced_blocks_per_sec"] = round(n_blocks / efrc_s, 2)
+    if cold_rate is not None:
+        detail["device_cold_blocks_per_sec"] = round(cold_rate, 2)
+    detail.update(_tunnel_probe(platform))
     if tpu_err:
         detail["tpu_expected_but_absent"] = tpu_err
     detail.update(bench_state_root(platform))

@@ -58,6 +58,50 @@ def jax_device_ok() -> bool:
     return _JAX_DEVICE_OK
 
 
+_LINK_PROFILE: tuple | None = None
+
+
+def device_link_profile() -> tuple:
+    """(upload_bytes_per_sec, roundtrip_sec), measured once per process.
+
+    The offload cost model needs real link numbers: a locally attached TPU
+    uploads at GB/s with sub-ms dispatch, while a tunneled development chip
+    can be ~20 MB/s with ~50ms round trips — three orders of magnitude that
+    flip which batch sizes are worth shipping. Probing costs ~0.3s once.
+    Overridable for tests/ops via PHANT_LINK_MBPS / PHANT_LINK_RTT_MS."""
+    global _LINK_PROFILE
+    import os
+
+    if _LINK_PROFILE is not None:
+        return _LINK_PROFILE
+    mbps = os.environ.get("PHANT_LINK_MBPS")
+    rtt = os.environ.get("PHANT_LINK_RTT_MS")
+    if mbps and rtt:
+        _LINK_PROFILE = (float(mbps) * 1e6, float(rtt) / 1e3)
+        return _LINK_PROFILE
+    try:
+        import time
+
+        import jax.numpy as jnp
+        import numpy as np
+
+        tiny = jnp.zeros((8,), jnp.uint32)
+        int(jnp.sum(tiny))  # warm dispatch path
+        t0 = time.perf_counter()
+        int(jnp.sum(tiny))
+        lat = time.perf_counter() - t0
+        # random payload: a compressing transport must not flatter the probe
+        x = np.random.default_rng(0).integers(0, 256, size=1 << 20).astype(np.uint8)
+        int(jnp.sum(jnp.asarray(x)[:8]))  # warm transfer path
+        t0 = time.perf_counter()
+        int(jnp.sum(jnp.asarray(x)[:8]))
+        up = max(time.perf_counter() - t0 - lat, 1e-9)
+        _LINK_PROFILE = (len(x) / up, lat)
+    except Exception:
+        _LINK_PROFILE = (1.0, 3600.0)  # unusable link
+    return _LINK_PROFILE
+
+
 def set_evm_backend(name: str) -> None:
     global _EVM_BACKEND
     if name not in _VALID_EVM:

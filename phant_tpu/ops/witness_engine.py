@@ -1,0 +1,377 @@
+"""Memoized witness-verification engine: hash once, verify forever.
+
+A continuously-validating stateless client sees the same trie nodes over and
+over: the upper levels of the state trie change only along the paths the
+previous block wrote, so consecutive block witnesses overlap heavily. The
+reference client ignores this structure — it recomputes every node hash of
+every block from scratch (reference scope: src/mpt/mpt.zig:38-119 recomputes
+the root per block; src/crypto/hasher.zig:4-17 hashes one node at a time,
+no reuse). This engine is the framework's north-star redesign of that loop:
+
+  * every UNIQUE node byte-string is keccak-hashed exactly once, in large
+    batches, on the selected crypto backend (the TPU kernel behind
+    `--crypto_backend=tpu`, the native C batch otherwise);
+  * digests and the parent->child hash references are interned into integer
+    ids, so per-block linked-multiproof verification — "the nodes form a
+    connected subtree rooted at the claimed state root" — collapses to a
+    vectorized integer join (numpy sort + searchsorted), with no
+    cryptography on the hot path at all;
+  * the interning survives across blocks/batches, so the steady-state cost
+    of validating block N is hashing the handful of nodes block N-1's
+    writes actually changed.
+
+Soundness: a digest is only ever computed from the full node bytes by the
+(differential-tested) keccak backends, and ref->row resolution uses exact
+256-bit digest equality via byte-keyed dicts. Memoization is sound because
+keccak is a function; linking a foreign node would need a collision.
+Verdict semantics are identical to ops/witness_jax.witness_verify_fused and
+mpt/proof.verify_witness_linked (differential-tested in
+tests/test_witness_engine.py).
+
+Memory is bounded: `max_nodes` caps the interned set; crossing it drops the
+oldest generation of interned nodes (their parents' child links are
+re-resolved lazily if the same bytes are ever re-inserted).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from phant_tpu.ops.witness_jax import (
+    WITNESS_MAX_CHUNKS,
+    _account_storage_root_off,
+    _rlp_item_bounds,
+    _scan_list_refs,
+)
+
+_NO_ROW = np.int64(-1)
+
+
+def _extract_ref_digests(node: bytes) -> List[bytes]:
+    """The 32-byte child hash references of one RLP trie node (branch
+    children, extension child, account-leaf storage root). Malformed nodes
+    reference nothing (they can still BE referenced — same contract as the
+    device kernel's _extract_ref_positions)."""
+    try:
+        mv = memoryview(node)
+        kind, ps, pe, pos = _rlp_item_bounds(mv, len(node), 0)
+        if kind != 1 or pos != len(node):
+            return []
+        offs: List[int] = []
+        _scan_list_refs(mv, ps, pe, offs)
+        return [node[o : o + 32] for o in offs]
+    except ValueError:
+        return []
+
+
+class WitnessEngine:
+    """Cross-block memoized linked-multiproof verifier.
+
+    One instance owns an interning table (digest <-> integer row) plus the
+    resolved child-link graph; `verify_batch` verifies whole batches of
+    (root, nodes) block witnesses against it.
+    """
+
+    def __init__(
+        self,
+        hasher: Optional[object] = None,
+        max_nodes: int = 1 << 20,
+        device_batch_floor: int = -1,
+    ):
+        """device_batch_floor: minimum novel-batch size that goes to the
+        device hasher under `--crypto_backend=tpu`. -1 (default) = adaptive:
+        measure the host->device link once and engage the device only when
+        the cost model says a batch beats the native path — a tunneled chip
+        (~20 MB/s) never qualifies for byte-dense hashing, a locally
+        attached one (~GB/s) qualifies from a few thousand nodes up. This
+        is the mechanism behind round-2's "never slower than cpu" demand:
+        the flag routes by measured cost, not by hope."""
+        # node bytes -> row (the memoization key: raw bytes, no hashing
+        # needed to test membership)
+        self._row_of_bytes: Dict[bytes, int] = {}
+        # digest bytes -> row (for root lookups and ref resolution)
+        self._row_of_digest: Dict[bytes, int] = {}
+        # unresolved ref digest -> [(parent_row, slot), ...]
+        self._pending: Dict[bytes, List[Tuple[int, int]]] = {}
+        # growable per-row tables
+        cap = 1024
+        self._digests = np.zeros((cap, 32), np.uint8)
+        self._child_rows = np.full((cap, 17), _NO_ROW, np.int64)
+        self._n_rows = 0
+        self._max_nodes = max_nodes
+        self._hasher = hasher  # callable: List[bytes] -> List[bytes]
+        self._device_batch_floor = device_batch_floor
+        self._lock = threading.Lock()  # Engine API serves from threads
+        self.stats = {"hashed": 0, "hits": 0, "evictions": 0}
+
+    # conservative throughput constants for the adaptive cost model (bytes/s
+    # of keccak input): the native C batch on one core vs the device kernel
+    # at saturation. Measured on this image; only their RATIO gates routing,
+    # so ±2x miscalibration moves the crossover, not the asymptotes.
+    _NATIVE_BPS = 45e6
+    _DEVICE_BPS = 250e6
+
+    def _device_pays(self, nodes: List[bytes]) -> bool:
+        """Adaptive routing: ship the batch only if upload + round trip +
+        device hash beats hashing natively on the host."""
+        from phant_tpu.backend import device_link_profile
+
+        nbytes = sum(len(n) for n in nodes)
+        up_bps, rtt = device_link_profile()
+        device_s = nbytes / up_bps + rtt + nbytes / self._DEVICE_BPS
+        native_s = nbytes / self._NATIVE_BPS
+        return device_s < native_s
+
+    # -- hashing backends ---------------------------------------------------
+
+    def _hash_batch(self, nodes: List[bytes]) -> List[bytes]:
+        if self._hasher is not None:
+            return list(self._hasher(nodes))
+        from phant_tpu.backend import crypto_backend, jax_device_ok
+
+        floor_ok = (
+            self._device_pays(nodes)
+            if self._device_batch_floor < 0
+            else len(nodes) >= self._device_batch_floor
+        )
+        if crypto_backend() == "tpu" and floor_ok and jax_device_ok():
+            try:
+                out = self._hash_batch_device(nodes)
+                self.stats["device_batches"] = (
+                    self.stats.get("device_batches", 0) + 1
+                )
+                return out
+            except Exception:
+                import logging
+
+                logging.getLogger("phant.witness").warning(
+                    "device keccak failed for %d nodes; native fallback",
+                    len(nodes),
+                    exc_info=True,
+                )
+        self.stats["native_batches"] = self.stats.get("native_batches", 0) + 1
+        from phant_tpu.utils.native import load_native
+
+        native = load_native()
+        if native is not None:
+            return list(native.keccak256_batch(nodes))
+        from phant_tpu.crypto.keccak import keccak256
+
+        return [keccak256(n) for n in nodes]
+
+    @staticmethod
+    def _hash_batch_device(nodes: List[bytes]) -> List[bytes]:
+        """One fused device dispatch: ship the concatenated novel bytes,
+        hash them with the chunked keccak kernel, read the digests back.
+        The transfer is the novel bytes + 2B/node — the memoized design
+        makes this the ONLY recurring h2d traffic of witness verification.
+        Both the node axis AND the blob byte axis are padded to power-of-two
+        buckets so repeat calls hit a small set of compiled shapes (a
+        ragged blob length would recompile per call)."""
+        import jax.numpy as jnp
+
+        from phant_tpu.crypto.keccak import RATE
+        from phant_tpu.ops.keccak_jax import digests_to_bytes
+        from phant_tpu.ops.witness_jax import _pow2ceil, witness_digests
+
+        raw = b"".join(nodes)
+        blob_len = _pow2ceil(len(raw) + WITNESS_MAX_CHUNKS * RATE)
+        blob = np.zeros(blob_len, np.uint8)
+        blob[: len(raw)] = np.frombuffer(raw, np.uint8)
+        B = _pow2ceil(len(nodes))
+        lens = np.zeros(B, np.int32)
+        lens[: len(nodes)] = [len(n) for n in nodes]
+        offsets = np.zeros(B, np.int32)
+        np.cumsum(lens[:-1], out=offsets[1:])
+        out = witness_digests(
+            jnp.asarray(blob),
+            jnp.asarray(offsets),
+            jnp.asarray(lens),
+            max_chunks=WITNESS_MAX_CHUNKS,
+        )
+        return digests_to_bytes(np.asarray(out))[: len(nodes)]
+
+    @staticmethod
+    def _refs_for_batch(nodes: List[bytes]) -> List[List[bytes]]:
+        """Child hash references per node, batched through the native C
+        scanner when available (one call for the whole novel set); malformed
+        nodes — which the native scanner rejects wholesale — fall back to
+        the per-node Python walk that marks just the bad ones ref-less."""
+        from phant_tpu.utils.native import load_native
+
+        native = load_native()
+        if native is not None:
+            raw = b"".join(nodes)
+            lens = np.fromiter((len(n) for n in nodes), np.uint32, len(nodes))
+            offsets = np.zeros(len(nodes), np.uint64)
+            if len(nodes) > 1:
+                np.cumsum(lens[:-1], out=offsets[1:])
+            blob = np.frombuffer(raw, np.uint8)
+            try:
+                ref_off, ref_node = native.scan_refs(blob, offsets, lens)
+            except ValueError:
+                return [_extract_ref_digests(n) for n in nodes]
+            out: List[List[bytes]] = [[] for _ in nodes]
+            for o, i in zip(ref_off.tolist(), ref_node.tolist()):
+                out[i].append(raw[o : o + 32])
+            return out
+        return [_extract_ref_digests(n) for n in nodes]
+
+    # -- interning ----------------------------------------------------------
+
+    def _grow(self, need: int) -> None:
+        cap = self._digests.shape[0]
+        if need <= cap:
+            return
+        new_cap = cap
+        while new_cap < need:
+            new_cap *= 2
+        d = np.zeros((new_cap, 32), np.uint8)
+        d[:cap] = self._digests
+        c = np.full((new_cap, 17), _NO_ROW, np.int64)
+        c[:cap] = self._child_rows
+        self._digests, self._child_rows = d, c
+
+    def _evict_all(self) -> None:
+        """Generation flush: drop the whole interned set and start row ids
+        over. Safe because nothing outside the (just-cleared) dicts holds row
+        ids, and every insert fully re-initializes its child_rows row."""
+        self.stats["evictions"] += 1
+        self._row_of_bytes.clear()
+        self._row_of_digest.clear()
+        self._pending.clear()
+        self._n_rows = 0
+
+    def intern(self, nodes: Sequence[bytes]) -> np.ndarray:
+        """Rows for `nodes`, hashing the never-seen ones in one batch."""
+        rows = np.empty(len(nodes), np.int64)
+        novel: List[bytes] = []
+        novel_idx: List[int] = []
+        seen_this_call: Dict[bytes, int] = {}
+        for i, nb in enumerate(nodes):
+            r = self._row_of_bytes.get(nb)
+            if r is not None:
+                rows[i] = r
+                self.stats["hits"] += 1
+                continue
+            j = seen_this_call.get(nb)
+            if j is not None:
+                rows[i] = -2 - j  # forward ref into this call's novel list
+                continue
+            seen_this_call[nb] = len(novel)
+            rows[i] = -2 - len(novel)
+            novel_idx.append(i)
+            novel.append(nb)
+
+        if novel:
+            if (
+                len(self._row_of_bytes) + len(novel) > self._max_nodes
+                and self._row_of_bytes  # an over-cap single batch still runs
+            ):
+                self._evict_all()
+                return self.intern(nodes)  # re-intern into the new generation
+            digests = self._hash_batch(novel)
+            refs_by_node = self._refs_for_batch(novel)
+            self.stats["hashed"] += len(novel)
+            base_row = self._n_rows
+            self._n_rows += len(novel)
+            self._grow(self._n_rows)
+            # pass 1: register every novel digest before resolving any refs,
+            # so same-batch parent->child links (the common case: proofs are
+            # shipped root-to-leaf) resolve directly instead of churning
+            # through the pending table
+            self._digests[base_row : self._n_rows] = np.frombuffer(
+                b"".join(digests), np.uint8
+            ).reshape(-1, 32)
+            self._child_rows[base_row : self._n_rows] = _NO_ROW  # gen reuse
+            row_of_bytes = self._row_of_bytes
+            row_of_digest = self._row_of_digest
+            for k, (nb, dg) in enumerate(zip(novel, digests)):
+                row_of_bytes[nb] = base_row + k
+                row_of_digest[dg] = base_row + k
+            # pass 2: resolve child refs (cross-batch misses go pending)
+            child_rows = self._child_rows
+            pending = self._pending
+            for k, refs in enumerate(refs_by_node):
+                row = base_row + k
+                for slot, ref in enumerate(refs[:17]):
+                    child = row_of_digest.get(ref)
+                    if child is None:
+                        pending.setdefault(ref, []).append((row, slot))
+                    else:
+                        child_rows[row, slot] = child
+            # pass 3: late binding — older parents waiting on these digests
+            if pending:
+                for k, dg in enumerate(digests):
+                    waiters = pending.pop(dg, None)
+                    if waiters:
+                        for prow, pslot in waiters:
+                            child_rows[prow, pslot] = base_row + k
+            # patch forward refs
+            neg = rows < -1
+            if neg.any():
+                rows[neg] = base_row + (-2 - rows[neg])
+        return rows
+
+    # -- verification -------------------------------------------------------
+
+    def verify_batch(
+        self, witnesses: Sequence[Tuple[bytes, Sequence[bytes]]]
+    ) -> np.ndarray:
+        """(n_blocks,) bool — full linked-multiproof verdict per block.
+
+        Block b verifies iff some node's digest equals root_b AND every node
+        is that root or is hash-referenced by another node of block b
+        (exactly witness_verify_fused's semantics; references are acyclic
+        because a cycle would be a keccak collision)."""
+        n_blocks = len(witnesses)
+        all_nodes: List[bytes] = []
+        counts = np.empty(n_blocks, np.int64)
+        for b, (_root, nodes) in enumerate(witnesses):
+            counts[b] = len(nodes)
+            all_nodes.extend(nodes)
+        with self._lock:
+            return self._verify_interned(witnesses, all_nodes, counts, n_blocks)
+
+    def _verify_interned(self, witnesses, all_nodes, counts, n_blocks):
+        rows = self.intern(all_nodes)
+        block_id = np.repeat(np.arange(n_blocks, dtype=np.int64), counts)
+
+        root_row = np.fromiter(
+            (self._row_of_digest.get(root, -1) for root, _n in witnesses),
+            np.int64,
+            n_blocks,
+        )
+
+        # per-(block, row) edge join, all integer ops: node ok <=> it is the
+        # block's root row, or some node of the same block has a child link
+        # to its row. 64-bit pairing key = block * stride + row.
+        children = self._child_rows[rows]  # (N, 17)
+        live = children >= 0
+        stride = np.int64(self._n_rows + 1)
+        edge_keys = np.unique((block_id[:, None] * stride + children)[live])
+        node_keys = block_id * stride + rows
+        if len(edge_keys):
+            idx = np.searchsorted(edge_keys, node_keys)
+            referenced = (idx < len(edge_keys)) & (
+                edge_keys[np.minimum(idx, len(edge_keys) - 1)] == node_keys
+            )
+        else:
+            referenced = np.zeros(len(node_keys), bool)
+        is_root = rows == root_row[block_id]
+        ok_node = referenced | is_root
+
+        all_ok = np.ones(n_blocks, bool)
+        np.logical_and.at(all_ok, block_id, ok_node)
+        root_hit = root_row >= 0
+        # the root row must actually be present among the block's nodes
+        root_present = np.zeros(n_blocks, bool)
+        np.logical_or.at(root_present, block_id, is_root)
+        return all_ok & root_hit & root_present & (counts > 0)
+
+    def verify(self, state_root: bytes, nodes: Sequence[bytes]) -> bool:
+        """Single-witness convenience wrapper (the Engine API path)."""
+        return bool(self.verify_batch([(state_root, list(nodes))])[0])

@@ -358,6 +358,11 @@ class StateDB:
                 key = keccak256(addr)
                 if acct is None or (acct.is_empty() and not acct.storage):
                     self._root_trie.delete(key)
+                    # drop the retained storage trie too: a deleted account's
+                    # (acct, trie) entry would otherwise pin the dead Account
+                    # and its whole trie for the StateDB's lifetime
+                    self._storage_tries.pop(addr, None)
+                    self._storage_dirty.pop(addr, None)
                 else:
                     leaf = rlp.encode([
                         rlp.encode_uint(acct.nonce),

@@ -1,0 +1,209 @@
+"""WitnessEngine: differential + memoization + corruption tests.
+
+The engine must agree bit-for-bit with the two existing verifiers —
+mpt/proof.verify_witness_linked (host BFS) and
+ops/witness_jax.witness_verify_fused (device kernel) — on valid witnesses
+and on every corruption class, while hashing each unique node only once.
+"""
+
+import numpy as np
+import pytest
+
+from phant_tpu import rlp
+from phant_tpu.crypto.keccak import keccak256
+from phant_tpu.mpt.mpt import Trie
+from phant_tpu.mpt.proof import generate_proof, verify_witness_linked
+from phant_tpu.ops.witness_engine import WitnessEngine
+
+
+def _build_trie(n=256, seed=5):
+    rng = np.random.default_rng(seed)
+    trie = Trie()
+    keys = []
+    for _ in range(n):
+        k = keccak256(rng.bytes(20))
+        trie.put(k, rlp.encode([rlp.encode_uint(1), rng.bytes(8)]))
+        keys.append(k)
+    return trie, keys, trie.root_hash()
+
+
+def _witness(trie, keys, picks, rng):
+    idx = rng.choice(len(keys), size=picks, replace=False)
+    nodes = {}
+    for i in idx:
+        for n in generate_proof(trie, keys[i]):
+            nodes[n] = None
+    return list(nodes.keys())
+
+
+@pytest.fixture()
+def setup():
+    trie, keys, root = _build_trie()
+    rng = np.random.default_rng(9)
+    witnesses = [(root, _witness(trie, keys, 8, rng)) for _ in range(12)]
+    return trie, keys, root, witnesses
+
+
+def test_valid_batch_verifies(setup):
+    _trie, _keys, _root, witnesses = setup
+    eng = WitnessEngine()
+    out = eng.verify_batch(witnesses)
+    assert out.all()
+    # differential: host BFS agrees on every block
+    for root, nodes in witnesses:
+        assert verify_witness_linked(root, nodes)
+
+
+def test_memoization_hashes_each_unique_node_once(setup):
+    _trie, _keys, _root, witnesses = setup
+    eng = WitnessEngine()
+    eng.verify_batch(witnesses)
+    unique = {n for _r, nodes in witnesses for n in nodes}
+    assert eng.stats["hashed"] == len(unique)
+    before = eng.stats["hashed"]
+    out = eng.verify_batch(witnesses)  # fully cached second pass
+    assert out.all()
+    assert eng.stats["hashed"] == before
+
+
+def test_corruptions_rejected(setup):
+    _trie, _keys, root, witnesses = setup
+    eng = WitnessEngine()
+    nodes = list(witnesses[0][1])
+
+    # wrong root
+    assert not eng.verify(b"\x00" * 32, nodes)
+    # missing root node (drop the node that hashes to the root)
+    no_root = [n for n in nodes if keccak256(n) != root]
+    assert not eng.verify(root, no_root)
+    # unlinked extra node (a foreign node nothing references)
+    foreign = rlp.encode([b"\x20\x99", b"zzz"])
+    assert not eng.verify(root, nodes + [foreign])
+    # a flipped byte inside a node breaks the parent->child link
+    victim = max(nodes, key=len)
+    flipped = bytes([victim[0]]) + bytes([victim[1] ^ 1]) + victim[2:]
+    broken = [flipped if n == victim else n for n in nodes]
+    assert not eng.verify(root, broken)
+    # empty witness
+    assert not eng.verify(root, [])
+    # the valid witness still verifies after all that interning
+    assert eng.verify(root, nodes)
+    # differential: the host BFS agrees on every corruption verdict
+    assert not verify_witness_linked(b"\x00" * 32, nodes)
+    assert not verify_witness_linked(root, no_root)
+    assert not verify_witness_linked(root, nodes + [foreign])
+    assert not verify_witness_linked(root, broken)
+
+
+def test_late_binding_child_arrives_in_later_batch(setup):
+    _trie, _keys, root, witnesses = setup
+    eng = WitnessEngine()
+    nodes = list(witnesses[0][1])
+    assert len(nodes) >= 2
+    # first: intern only the root node (a trivially-valid one-node witness;
+    # its child refs stay pending)
+    root_node = next(n for n in nodes if keccak256(n) == root)
+    assert eng.verify(root, [root_node])
+    assert eng._pending  # children unresolved
+    # later: the full witness arrives; the CACHED root node's child links
+    # must late-bind to the newly interned children or linkage breaks
+    assert eng.verify(root, nodes)
+    hashed = eng.stats["hashed"]
+    assert hashed == len(set(nodes))  # root node not re-hashed
+
+
+def test_eviction_keeps_correctness(setup):
+    _trie, _keys, root, witnesses = setup
+    unique = {n for _r, nodes in witnesses for n in nodes}
+    eng = WitnessEngine(max_nodes=max(4, len(unique) // 3))
+    for root_, nodes in witnesses:
+        assert eng.verify(root_, nodes)
+    assert eng.stats["evictions"] >= 1
+    # post-eviction verification still sound
+    assert eng.verify(root, list(witnesses[0][1]))
+    assert not eng.verify(b"\x11" * 32, list(witnesses[0][1]))
+
+
+def test_differential_vs_device_kernel(setup):
+    jax = pytest.importorskip("jax")
+    import jax.numpy as jnp
+
+    from phant_tpu.ops.witness_jax import (
+        WITNESS_MAX_CHUNKS,
+        pack_witness_fused,
+        roots_to_words,
+        witness_verify_fused,
+    )
+
+    _trie, _keys, root, witnesses = setup
+    cases = list(witnesses[:4])
+    # add corruption cases to the batch
+    nodes0 = list(witnesses[0][1])
+    foreign = rlp.encode([b"\x20\x99", b"zzz"])
+    cases.append((root, nodes0 + [foreign]))
+    cases.append((b"\x00" * 32, nodes0))
+
+    eng = WitnessEngine()
+    got = eng.verify_batch(cases)
+
+    blob, meta16 = pack_witness_fused([n for _r, n in cases], WITNESS_MAX_CHUNKS)
+    out = witness_verify_fused(
+        jnp.asarray(blob),
+        jnp.asarray(meta16),
+        jnp.asarray(roots_to_words([r for r, _n in cases])),
+        max_chunks=WITNESS_MAX_CHUNKS,
+        n_blocks=len(cases),
+    )
+    want = np.asarray(out)
+    assert (got == want).all(), (got, want)
+    assert list(got) == [True, True, True, True, False, False]
+
+
+def test_storage_subtree_linked_through_account_leaf():
+    rng = np.random.default_rng(13)
+    storage = Trie()
+    skeys = []
+    for _ in range(64):
+        sk = keccak256(rng.bytes(32))
+        storage.put(sk, rlp.encode(rlp.encode_uint(7)))
+        skeys.append(sk)
+    sroot = storage.root_hash()
+
+    trie = Trie()
+    akeys = []
+    for i in range(128):
+        k = keccak256(rng.bytes(20))
+        leaf = rlp.encode(
+            [
+                rlp.encode_uint(1),
+                rlp.encode_uint(10**18),
+                sroot if i % 2 == 0 else rng.bytes(32),
+                rng.bytes(32),
+            ]
+        )
+        trie.put(k, leaf)
+        akeys.append(k)
+    root = trie.root_hash()
+
+    # find an account whose leaf commits sroot
+    nodes = {}
+    anchor = None
+    for i in range(0, 128, 2):
+        proof = generate_proof(trie, akeys[i])
+        if sroot in proof[-1]:
+            anchor = i
+            break
+    assert anchor is not None
+    for n in generate_proof(trie, akeys[anchor]):
+        nodes[n] = None
+    for sk in skeys[:8]:
+        for n in generate_proof(storage, sk):
+            nodes[n] = None
+
+    eng = WitnessEngine()
+    assert eng.verify(root, list(nodes.keys()))
+    assert verify_witness_linked(root, list(nodes.keys()))
+    # without the anchoring account leaf, the storage nodes are unlinked
+    unanchored = [n for n in nodes if sroot not in n or len(n) < 32]
+    if len(unanchored) < len(nodes):
+        assert not eng.verify(root, unanchored)
