@@ -377,13 +377,18 @@ def trie_root_hash(trie: Trie) -> bytes:
 
     Tiny tries (a handful of txs/receipts) stay on the host even on the tpu
     backend: per-level dispatch latency would dwarf the hashing. The
-    threshold is leaf-count based (PHANT_TPU_MIN_TRIE, default 192)."""
+    threshold is leaf-count based (PHANT_TPU_MIN_TRIE, default 192), and on
+    top of it the measured link profile must say the shipped bytes beat the
+    native hasher (phant_tpu/backend.py device_link_profile) — a tunneled
+    chip never qualifies for byte-dense hashing, so the flag cannot regress
+    the block path (round-2 demand: never slower than cpu end-to-end)."""
     from phant_tpu.backend import crypto_backend, jax_device_ok
 
     if (
         crypto_backend() == "tpu"
         and trie.approx_size >= _min_device_trie()
         and jax_device_ok()
+        and _device_root_pays(trie)
     ):
         from phant_tpu.ops.mpt_jax import trie_root_device
 
@@ -395,6 +400,24 @@ def _min_device_trie() -> int:
     import os
 
     return int(os.environ.get("PHANT_TPU_MIN_TRIE", "192"))
+
+
+def _device_root_pays(trie: Trie) -> bool:
+    """Link-aware offload gate for device trie roots: ship the plan only
+    when upload + round trip beats hashing the same bytes natively. Uses
+    ~600B per leaf (leaf + amortized branch encodings) and the same
+    throughput constants as the witness engine's cost model."""
+    import os
+
+    if os.environ.get("PHANT_TPU_FORCE_TRIE", "0") not in ("", "0"):
+        return True
+    from phant_tpu.backend import device_link_profile
+    from phant_tpu.ops.witness_engine import WitnessEngine
+
+    nbytes = trie.approx_size * 600
+    up_bps, rtt = device_link_profile()
+    device_s = nbytes / up_bps + rtt + nbytes / WitnessEngine._DEVICE_BPS
+    return device_s < nbytes / WitnessEngine._NATIVE_BPS
 
 
 def trie_root(pairs: Iterable[Tuple[bytes, bytes]]) -> bytes:

@@ -481,8 +481,10 @@ def shared_witness_engine():
 
             _witness_engine = WitnessEngine(
                 max_nodes=int(os.environ.get("PHANT_WITNESS_CACHE", 1 << 20)),
+                # -1 = adaptive link-aware routing (the engine's cost model);
+                # a fixed floor is an explicit operator override
                 device_batch_floor=int(
-                    os.environ.get("PHANT_TPU_MIN_KECCAK", 2048)
+                    os.environ.get("PHANT_TPU_MIN_KECCAK", -1)
                 ),
             )
         return _witness_engine

@@ -20,6 +20,9 @@ if os.environ.get("PHANT_TEST_TPU", "0") in ("", "0"):
     # otherwise re-route tpu-backend differential tests to the CPU path;
     # here the CPU-mesh jax run IS the point
     os.environ["PHANT_ALLOW_JAX_CPU"] = "1"
+    os.environ.setdefault("PHANT_TPU_FORCE_TRIE", "1")  # bypass the link
+    # cost model: differential tests must exercise the device dispatch even
+    # though a CPU-mesh "link" never pays off for tiny tries
     os.environ.setdefault("PHANT_TPU_MIN_TRIE", "1")  # small test tries must
     # still exercise the device dispatch path
     os.environ.setdefault("PHANT_TPU_MIN_ECRECOVER", "1")  # likewise for the
