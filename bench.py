@@ -473,6 +473,10 @@ def main() -> None:
 
     # engine on native C hashing (architecture-only contribution)
     ecpu_s, novel, _st = run_engine(hasher=_native_hasher())
+    _PARTIAL["detail"]["cpu_baseline_blocks_per_sec"] = round(cpu_rate, 2)
+    _PARTIAL["detail"]["engine_cpu_blocks_per_sec"] = round(n_blocks / ecpu_s, 2)
+    _PARTIAL["value"] = round(n_blocks / ecpu_s, 2)
+    _PARTIAL["vs_baseline"] = round((n_blocks / ecpu_s) / cpu_rate, 2)
     device_err = None
     edev_s, rstats, efrc_s = ecpu_s, {}, None
     if platform != "cpu":
@@ -482,19 +486,22 @@ def main() -> None:
                 # link-aware routing (ships a novel batch to the chip only
                 # when the measured link says it beats the native hasher)
                 edev_s, novel, rstats = run_engine(backend="tpu")
+            _PARTIAL["value"] = round(n_blocks / edev_s, 2)
+            _PARTIAL["vs_baseline"] = round((n_blocks / edev_s) / cpu_rate, 2)
         except Exception as e:
             device_err = repr(e)[:200]
             edev_s, rstats = ecpu_s, {}
-        try:
-            with _watchdog():
-                # transparency: the device FORCED on every novel batch —
-                # its failure must not clobber the routed result above
-                efrc_s, _n, _s = run_engine(
-                    hasher=WitnessEngine._hash_batch_device, eng_batch=256
-                )
-        except Exception as e:
-            device_err = device_err or repr(e)[:200]
-            efrc_s = None
+        if device_err is None:  # don't burn a watchdog on a known-dead device
+            try:
+                with _watchdog():
+                    # transparency: the device FORCED on every novel batch —
+                    # its failure must not clobber the routed result above
+                    efrc_s, _n, _s = run_engine(
+                        hasher=WitnessEngine._hash_batch_device, eng_batch=256
+                    )
+            except Exception as e:
+                device_err = repr(e)[:200]
+                efrc_s = None
     dev_rate = n_blocks / edev_s
 
     # --- cold fused device kernel (no memoization), honest sync ------------
@@ -605,7 +612,8 @@ def _bench_state_root_inner(platform: str) -> dict:
 
         rng = np.random.default_rng(11)
         trie = Trie()
-        for _ in range(2048):
+        n_accounts = int(os.environ.get("PHANT_BENCH_SR_ACCOUNTS", "2048"))
+        for _ in range(n_accounts):
             leaf = rlp.encode(
                 [
                     rlp.encode_uint(int(rng.integers(0, 1000))),
@@ -655,7 +663,7 @@ def _bench_state_root_inner(platform: str) -> dict:
             "state_root_cpu_coldwalk_p50_ms": round(
                 float(np.median(cold_t)) * 1e3, 2
             ),
-            "state_root_accounts": 2048,
+            "state_root_accounts": n_accounts,
         }
     except Exception as e:
         return {"state_root_error": repr(e)[:200]}

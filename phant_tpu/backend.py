@@ -102,6 +102,23 @@ def device_link_profile() -> tuple:
     return _LINK_PROFILE
 
 
+# conservative throughput constants for the adaptive offload cost model
+# (bytes/s of keccak input): the native C batch on one core vs the device
+# kernel at saturation. Measured on this image; only their RATIO gates
+# routing, so ±2x miscalibration moves the crossover, not the asymptotes.
+NATIVE_HASH_BPS = 45e6
+DEVICE_HASH_BPS = 250e6
+
+
+def device_offload_pays(nbytes: int) -> bool:
+    """Shared offload gate for byte-dense hashing work (witness novel-node
+    batches, trie-root plans): ship only if upload + round trip + device
+    hash beats hashing the same bytes natively on the host. Callers must
+    check the crypto backend BEFORE calling — this probes the device link."""
+    up_bps, rtt = device_link_profile()
+    return nbytes / up_bps + rtt + nbytes / DEVICE_HASH_BPS < nbytes / NATIVE_HASH_BPS
+
+
 def set_evm_backend(name: str) -> None:
     global _EVM_BACKEND
     if name not in _VALID_EVM:

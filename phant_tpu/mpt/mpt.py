@@ -404,20 +404,16 @@ def _min_device_trie() -> int:
 
 def _device_root_pays(trie: Trie) -> bool:
     """Link-aware offload gate for device trie roots: ship the plan only
-    when upload + round trip beats hashing the same bytes natively. Uses
-    ~600B per leaf (leaf + amortized branch encodings) and the same
-    throughput constants as the witness engine's cost model."""
+    when upload + round trip beats hashing the same bytes natively
+    (the shared cost model, phant_tpu/backend.py device_offload_pays).
+    Estimates ~600B per leaf (leaf + amortized branch encodings)."""
     import os
 
     if os.environ.get("PHANT_TPU_FORCE_TRIE", "0") not in ("", "0"):
         return True
-    from phant_tpu.backend import device_link_profile
-    from phant_tpu.ops.witness_engine import WitnessEngine
+    from phant_tpu.backend import device_offload_pays
 
-    nbytes = trie.approx_size * 600
-    up_bps, rtt = device_link_profile()
-    device_s = nbytes / up_bps + rtt + nbytes / WitnessEngine._DEVICE_BPS
-    return device_s < nbytes / WitnessEngine._NATIVE_BPS
+    return device_offload_pays(trie.approx_size * 600)
 
 
 def trie_root(pairs: Iterable[Tuple[bytes, bytes]]) -> bytes:

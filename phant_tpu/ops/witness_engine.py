@@ -92,14 +92,18 @@ class WitnessEngine:
         # node bytes -> row (the memoization key: raw bytes, no hashing
         # needed to test membership)
         self._row_of_bytes: Dict[bytes, int] = {}
-        # digest bytes -> row (for root lookups and ref resolution)
-        self._row_of_digest: Dict[bytes, int] = {}
-        # unresolved ref digest -> [(parent_row, slot), ...]
-        self._pending: Dict[bytes, List[Tuple[int, int]]] = {}
+        # digest bytes -> refid. EVERY 32-byte digest that appears — as a
+        # node's hash or inside a node as a child reference — gets one id,
+        # so parent->child linkage resolves at insert time with no pending
+        # table (an unresolved-ref table would grow with every off-path
+        # sibling digest, ~16x the node count, and those digests never
+        # arrive as nodes).
+        self._refid_of_digest: Dict[bytes, int] = {}
+        self._n_refids = 0
         # growable per-row tables
         cap = 1024
-        self._digests = np.zeros((cap, 32), np.uint8)
-        self._child_rows = np.full((cap, 17), _NO_ROW, np.int64)
+        self._own_refid = np.full(cap, _NO_ROW, np.int64)
+        self._child_refids = np.full((cap, 17), _NO_ROW, np.int64)
         self._n_rows = 0
         self._max_nodes = max_nodes
         self._hasher = hasher  # callable: List[bytes] -> List[bytes]
@@ -107,37 +111,25 @@ class WitnessEngine:
         self._lock = threading.Lock()  # Engine API serves from threads
         self.stats = {"hashed": 0, "hits": 0, "evictions": 0}
 
-    # conservative throughput constants for the adaptive cost model (bytes/s
-    # of keccak input): the native C batch on one core vs the device kernel
-    # at saturation. Measured on this image; only their RATIO gates routing,
-    # so ±2x miscalibration moves the crossover, not the asymptotes.
-    _NATIVE_BPS = 45e6
-    _DEVICE_BPS = 250e6
-
-    def _device_pays(self, nodes: List[bytes]) -> bool:
-        """Adaptive routing: ship the batch only if upload + round trip +
-        device hash beats hashing natively on the host."""
-        from phant_tpu.backend import device_link_profile
-
-        nbytes = sum(len(n) for n in nodes)
-        up_bps, rtt = device_link_profile()
-        device_s = nbytes / up_bps + rtt + nbytes / self._DEVICE_BPS
-        native_s = nbytes / self._NATIVE_BPS
-        return device_s < native_s
-
     # -- hashing backends ---------------------------------------------------
 
     def _hash_batch(self, nodes: List[bytes]) -> List[bytes]:
         if self._hasher is not None:
             return list(self._hasher(nodes))
-        from phant_tpu.backend import crypto_backend, jax_device_ok
+        from phant_tpu.backend import (
+            crypto_backend,
+            device_offload_pays,
+            jax_device_ok,
+        )
 
-        floor_ok = (
-            self._device_pays(nodes)
+        # backend check FIRST: the adaptive gate probes the device link,
+        # which must never happen on the pure-CPU path (a dead tunnel would
+        # hang a run that never asked for a device)
+        if crypto_backend() == "tpu" and jax_device_ok() and (
+            device_offload_pays(sum(len(n) for n in nodes))
             if self._device_batch_floor < 0
             else len(nodes) >= self._device_batch_floor
-        )
-        if crypto_backend() == "tpu" and floor_ok and jax_device_ok():
+        ):
             try:
                 out = self._hash_batch_device(nodes)
                 self.stats["device_batches"] = (
@@ -223,33 +215,45 @@ class WitnessEngine:
     # -- interning ----------------------------------------------------------
 
     def _grow(self, need: int) -> None:
-        cap = self._digests.shape[0]
+        cap = self._own_refid.shape[0]
         if need <= cap:
             return
         new_cap = cap
         while new_cap < need:
             new_cap *= 2
-        d = np.zeros((new_cap, 32), np.uint8)
-        d[:cap] = self._digests
+        o = np.full(new_cap, _NO_ROW, np.int64)
+        o[:cap] = self._own_refid
         c = np.full((new_cap, 17), _NO_ROW, np.int64)
-        c[:cap] = self._child_rows
-        self._digests, self._child_rows = d, c
+        c[:cap] = self._child_refids
+        self._own_refid, self._child_refids = o, c
 
     def _evict_all(self) -> None:
-        """Generation flush: drop the whole interned set and start row ids
-        over. Safe because nothing outside the (just-cleared) dicts holds row
-        ids, and every insert fully re-initializes its child_rows row."""
+        """Generation flush: drop the whole interned set and start ids over.
+        Safe because nothing outside the (just-cleared) dicts holds row or
+        ref ids, and every insert fully re-initializes its per-row entries."""
         self.stats["evictions"] += 1
         self._row_of_bytes.clear()
-        self._row_of_digest.clear()
-        self._pending.clear()
+        self._refid_of_digest.clear()
         self._n_rows = 0
+        self._n_refids = 0
+
+    def _refid(self, digest: bytes) -> int:
+        rid = self._refid_of_digest.get(digest)
+        if rid is None:
+            rid = self._n_refids
+            self._n_refids = rid + 1
+            self._refid_of_digest[digest] = rid
+        return rid
 
     def intern(self, nodes: Sequence[bytes]) -> np.ndarray:
-        """Rows for `nodes`, hashing the never-seen ones in one batch."""
+        """Rows for `nodes`, hashing the never-seen ones in one batch.
+
+        Each novel node's digest AND each of its child-reference digests are
+        interned to refids immediately, so linkage is fully resolved at
+        insert: a parent cached today links to a child that first arrives
+        as a node next week, because both map to the same refid."""
         rows = np.empty(len(nodes), np.int64)
         novel: List[bytes] = []
-        novel_idx: List[int] = []
         seen_this_call: Dict[bytes, int] = {}
         for i, nb in enumerate(nodes):
             r = self._row_of_bytes.get(nb)
@@ -263,7 +267,6 @@ class WitnessEngine:
                 continue
             seen_this_call[nb] = len(novel)
             rows[i] = -2 - len(novel)
-            novel_idx.append(i)
             novel.append(nb)
 
         if novel:
@@ -279,37 +282,18 @@ class WitnessEngine:
             base_row = self._n_rows
             self._n_rows += len(novel)
             self._grow(self._n_rows)
-            # pass 1: register every novel digest before resolving any refs,
-            # so same-batch parent->child links (the common case: proofs are
-            # shipped root-to-leaf) resolve directly instead of churning
-            # through the pending table
-            self._digests[base_row : self._n_rows] = np.frombuffer(
-                b"".join(digests), np.uint8
-            ).reshape(-1, 32)
-            self._child_rows[base_row : self._n_rows] = _NO_ROW  # gen reuse
+            self._child_refids[base_row : self._n_rows] = _NO_ROW  # gen reuse
             row_of_bytes = self._row_of_bytes
-            row_of_digest = self._row_of_digest
+            own_refid = self._own_refid
+            child_refids = self._child_refids
+            refid = self._refid
             for k, (nb, dg) in enumerate(zip(novel, digests)):
-                row_of_bytes[nb] = base_row + k
-                row_of_digest[dg] = base_row + k
-            # pass 2: resolve child refs (cross-batch misses go pending)
-            child_rows = self._child_rows
-            pending = self._pending
-            for k, refs in enumerate(refs_by_node):
                 row = base_row + k
+                row_of_bytes[nb] = row
+                own_refid[row] = refid(dg)
+                refs = refs_by_node[k]
                 for slot, ref in enumerate(refs[:17]):
-                    child = row_of_digest.get(ref)
-                    if child is None:
-                        pending.setdefault(ref, []).append((row, slot))
-                    else:
-                        child_rows[row, slot] = child
-            # pass 3: late binding — older parents waiting on these digests
-            if pending:
-                for k, dg in enumerate(digests):
-                    waiters = pending.pop(dg, None)
-                    if waiters:
-                        for prow, pslot in waiters:
-                            child_rows[prow, pslot] = base_row + k
+                    child_refids[row, slot] = refid(ref)
             # patch forward refs
             neg = rows < -1
             if neg.any():
@@ -340,20 +324,24 @@ class WitnessEngine:
         rows = self.intern(all_nodes)
         block_id = np.repeat(np.arange(n_blocks, dtype=np.int64), counts)
 
-        root_row = np.fromiter(
-            (self._row_of_digest.get(root, -1) for root, _n in witnesses),
+        # the root digest resolves through the same refid space; -1 when the
+        # digest has never been seen (as a node or a reference)
+        root_refid = np.fromiter(
+            (self._refid_of_digest.get(root, -1) for root, _n in witnesses),
             np.int64,
             n_blocks,
         )
 
-        # per-(block, row) edge join, all integer ops: node ok <=> it is the
-        # block's root row, or some node of the same block has a child link
-        # to its row. 64-bit pairing key = block * stride + row.
-        children = self._child_rows[rows]  # (N, 17)
+        # per-(block, refid) edge join, all integer ops: node ok <=> its
+        # digest is the block's root, or some node of the same block has a
+        # child reference to its digest. 64-bit pairing key =
+        # block * stride + refid.
+        own = self._own_refid[rows]  # (N,)
+        children = self._child_refids[rows]  # (N, 17)
         live = children >= 0
-        stride = np.int64(self._n_rows + 1)
+        stride = np.int64(self._n_refids + 1)
         edge_keys = np.unique((block_id[:, None] * stride + children)[live])
-        node_keys = block_id * stride + rows
+        node_keys = block_id * stride + own
         if len(edge_keys):
             idx = np.searchsorted(edge_keys, node_keys)
             referenced = (idx < len(edge_keys)) & (
@@ -361,16 +349,16 @@ class WitnessEngine:
             )
         else:
             referenced = np.zeros(len(node_keys), bool)
-        is_root = rows == root_row[block_id]
+        is_root = own == root_refid[block_id]
         ok_node = referenced | is_root
 
         all_ok = np.ones(n_blocks, bool)
         np.logical_and.at(all_ok, block_id, ok_node)
-        root_hit = root_row >= 0
-        # the root row must actually be present among the block's nodes
+        # some node of the block must actually hash to the root (a root
+        # refid that exists only as a reference is not enough)
         root_present = np.zeros(n_blocks, bool)
         np.logical_or.at(root_present, block_id, is_root)
-        return all_ok & root_hit & root_present & (counts > 0)
+        return all_ok & root_present & (counts > 0)
 
     def verify(self, state_root: bytes, nodes: Sequence[bytes]) -> bool:
         """Single-witness convenience wrapper (the Engine API path)."""

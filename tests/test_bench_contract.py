@@ -1,0 +1,89 @@
+"""Driver contract for bench.py: it must print exactly ONE parseable JSON
+line with the agreed schema, quickly, on CPU, with every section surviving.
+
+Runs bench.py in a subprocess at tiny shapes (the wall-clock knob the
+driver cannot pass itself) and checks the schema — the two prior rounds
+each shipped a bench/driver-contract regression in the final commit, so
+this is pinned by a test.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+
+@pytest.mark.slow
+def test_bench_prints_one_json_line_with_schema():
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env.update(
+        JAX_PLATFORMS="cpu",
+        PHANT_BENCH_WARM="8",
+        PHANT_BENCH_BLOCKS="16",
+        PHANT_BENCH_TRIE="1024",
+        PHANT_REPLAY_BLOCKS="12",
+        PHANT_BENCH_KECCAK_N="2048",
+        PHANT_BENCH_SR_ACCOUNTS="256",
+        PHANT_BENCH_ECRECOVER="0",  # the jax-cpu ladder is minutes-slow
+        PHANT_BENCH_PROBE_RETRIES="0",
+    )
+    out = subprocess.run(
+        [sys.executable, "bench.py"],
+        capture_output=True,
+        text=True,
+        timeout=900,
+        env=env,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    )
+    assert out.returncode == 0, out.stderr[-2000:]
+    json_lines = [
+        ln for ln in out.stdout.splitlines() if ln.startswith("{")
+    ]
+    assert len(json_lines) == 1, out.stdout[-2000:]
+    rec = json.loads(json_lines[0])
+    assert rec["metric"] == "block_witness_verifications_per_sec"
+    assert rec["unit"] == "blocks/s"
+    assert rec["value"] > 0
+    assert rec["vs_baseline"] > 0
+    detail = rec["detail"]
+    assert detail["timing"] == "forced-readback"
+    for key in (
+        "cpu_baseline_blocks_per_sec",
+        "engine_cpu_blocks_per_sec",
+        "replay_cpu_blocks_per_sec",
+        "replay_tpu_blocks_per_sec",
+        "state_root_cpu_p50_ms",
+        "keccak_hashes_per_sec",
+    ):
+        assert key in detail, (key, detail)
+
+
+@pytest.mark.slow
+def test_bench_global_deadline_always_prints_json():
+    """A hung tunnel must still yield the driver a JSON line: force the
+    global deadline to fire almost immediately and check the fallback."""
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env.update(
+        JAX_PLATFORMS="cpu",
+        PHANT_BENCH_WARM="8",
+        PHANT_BENCH_BLOCKS="16",
+        PHANT_BENCH_TRIE="1024",
+        PHANT_BENCH_GLOBAL_TIMEOUT="3",
+        PHANT_BENCH_PROBE_RETRIES="0",
+    )
+    out = subprocess.run(
+        [sys.executable, "bench.py"],
+        capture_output=True,
+        text=True,
+        timeout=300,
+        env=env,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    )
+    json_lines = [ln for ln in out.stdout.splitlines() if ln.startswith("{")]
+    assert len(json_lines) == 1, out.stdout[-2000:]
+    rec = json.loads(json_lines[0])
+    assert rec["detail"].get("global_deadline_hit_s") == 3.0
