@@ -670,11 +670,15 @@ def _bench_state_root_inner(platform: str) -> dict:
 
 
 def _build_replay_chain(n_blocks: int, txs_per_block: int):
-    """A synthetic value-transfer chain: `txs_per_block` funded senders each
-    send 1 wei per block (nonce = block index). Headers carry the exact
-    roots/gas the replay must recompute; state-root checking is off, matching
-    the reference's runBlock scope (src/blockchain/blockchain.zig:61-96,
-    state root TODO-disabled there)."""
+    """A synthetic mainnet-shaped chain: per block, `txs_per_block` value
+    transfers PLUS contract calls that SLOAD+SSTORE a counter (cold account
+    + cold slot per tx under EIP-2929), so the replay exercises the EVM
+    storage path, receipts with variable gas, and an evolving contract
+    storage trie — not just balance arithmetic (round-2 review: the replay
+    chain was value-transfers only). Headers carry the exact gas/roots the
+    replay must recompute, derived from actually executing each block on a
+    builder chain (reference scope: src/blockchain/blockchain.zig:61-96,
+    which TODO-disables the state-root check this bench re-enables)."""
     from phant_tpu.blockchain.chain import calculate_base_fee
     from phant_tpu.crypto import secp256k1 as secp
     from phant_tpu.mpt.mpt import EMPTY_TRIE_ROOT, ordered_trie_root
@@ -682,12 +686,16 @@ def _build_replay_chain(n_blocks: int, txs_per_block: int):
     from phant_tpu.state.statedb import StateDB
     from phant_tpu.types.account import Account
     from phant_tpu.types.block import Block, BlockHeader
-    from phant_tpu.types.receipt import Receipt, logs_bloom
+    from phant_tpu.types.receipt import logs_bloom
     from phant_tpu.types.transaction import LegacyTx
 
     chain_id = 1
     signer = TxSigner(chain_id)
-    keys = [int.from_bytes(bytes([i + 1]) * 32, "big") % secp.N for i in range(txs_per_block)]
+    n_calls = max(txs_per_block // 2, 1)  # contract calls ride along
+    keys = [
+        int.from_bytes(bytes([i + 1]) * 32, "big") % secp.N
+        for i in range(txs_per_block + n_calls)
+    ]
     senders = []
     genesis_accounts = {}
     for k in keys:
@@ -697,6 +705,12 @@ def _build_replay_chain(n_blocks: int, txs_per_block: int):
         senders.append(addr)
         genesis_accounts[addr] = Account(balance=10**24)
     recipient = b"\x99" * 20
+    # counter contract: slot0 += 1 per call (cold SLOAD + dirty SSTORE per
+    # tx under EIP-2929 — the storage path the transfers never touch)
+    counter_addr = b"\xc0" * 20
+    # PUSH1 0 SLOAD PUSH1 1 ADD PUSH1 0 SSTORE STOP
+    counter_code = bytes.fromhex("600054600101600055") + b"\x00"
+    genesis_accounts[counter_addr] = Account(balance=0, code=counter_code)
 
     gas_limit = 30_000_000
     base_fee = 10**9
@@ -723,15 +737,18 @@ def _build_replay_chain(n_blocks: int, txs_per_block: int):
     builder = Blockchain(chain_id, builder_state, genesis, verify_state_root=False)
     blocks = []
     parent = genesis
+    from dataclasses import replace
+
     for b in range(1, n_blocks + 1):
         txs = []
-        for k in keys:
+        for j, k in enumerate(keys):
+            is_call = j >= txs_per_block
             tx = LegacyTx(
                 nonce=b - 1,
                 gas_price=gas_price,
-                gas_limit=21_000,
-                to=recipient,
-                value=1,
+                gas_limit=60_000 if is_call else 21_000,
+                to=counter_addr if is_call else recipient,
+                value=0 if is_call else 1,
                 data=b"",
                 v=37,  # EIP-155 marker; sign() recomputes
                 r=0,
@@ -741,37 +758,37 @@ def _build_replay_chain(n_blocks: int, txs_per_block: int):
         base_fee = calculate_base_fee(
             parent.gas_limit, parent.gas_used, parent.base_fee_per_gas
         )
-        gas_used = 21_000 * len(txs)
-        receipts = [
-            Receipt(
-                tx_type=0,
-                succeeded=True,
-                cumulative_gas_used=21_000 * (i + 1),
-                logs=(),
-            )
-            for i in range(len(txs))
-        ]
         draft = BlockHeader(
             parent_hash=parent.hash(),
             block_number=b,
             gas_limit=gas_limit,
-            gas_used=gas_used,
+            gas_used=0,  # filled from execution below
             timestamp=parent.timestamp + 12,
             base_fee_per_gas=base_fee,
             transactions_root=ordered_trie_root([t.encode() for t in txs]),
-            receipts_root=ordered_trie_root([r.encode() for r in receipts]),
+            receipts_root=EMPTY_TRIE_ROOT,
             withdrawals_root=EMPTY_TRIE_ROOT,
             logs_bloom=logs_bloom([]),
         )
-        builder.apply_body(Block(header=draft, transactions=tuple(txs), withdrawals=()))
-        from dataclasses import replace
-
-        header = replace(draft, state_root=builder_state.state_root())
+        # execute on the builder; the REAL gas/receipts/bloom/state root
+        # become the header the replay must reproduce exactly
+        result = builder.apply_body(
+            Block(header=draft, transactions=tuple(txs), withdrawals=())
+        )
+        header = replace(
+            draft,
+            gas_used=result.gas_used,
+            receipts_root=ordered_trie_root(
+                [r.encode() for r in result.receipts]
+            ),
+            logs_bloom=result.logs_bloom,
+            state_root=builder_state.state_root(),
+        )
         builder.parent_header = header
         blocks.append(Block(header=header, transactions=tuple(txs), withdrawals=()))
         parent = header
 
-    return genesis, blocks, fresh_state
+    return genesis, blocks, fresh_state, txs_per_block + n_calls, n_calls
 
 
 def bench_replay(platform: str) -> dict:
@@ -796,9 +813,11 @@ def _bench_replay_inner(platform: str) -> dict:
 
         n_blocks = int(os.environ.get("PHANT_REPLAY_BLOCKS", "1000"))
         txs_per_block = int(os.environ.get("PHANT_REPLAY_TXS", "8"))
-        genesis, blocks, fresh_state = _build_replay_chain(n_blocks, txs_per_block)
         if native_available():
-            set_evm_backend("native")
+            set_evm_backend("native")  # builder executes every block too
+        genesis, blocks, fresh_state, total_txs, n_calls = _build_replay_chain(
+            n_blocks, txs_per_block
+        )
 
         def replay(backend: str, verify_root: bool = False) -> float:
             set_crypto_backend(backend)
@@ -825,7 +844,8 @@ def _bench_replay_inner(platform: str) -> dict:
         sr_t = replay("tpu", verify_root=True)
         out["replay_stateroot_tpu_blocks_per_sec"] = round(n_blocks / sr_t, 1)
         out["replay_blocks"] = n_blocks
-        out["replay_txs_per_block"] = txs_per_block
+        out["replay_txs_per_block"] = total_txs
+        out["replay_contract_calls_per_block"] = n_calls
         return out
     except Exception as e:
         return {"replay_error": repr(e)[:200]}

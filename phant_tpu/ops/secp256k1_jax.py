@@ -350,10 +350,7 @@ def _to_affine(X, Y, Z):
 
 def _bits_matrix(a: jnp.ndarray) -> jnp.ndarray:
     """(B,16) -> (256, B) scalar bit per ladder step, msb first."""
-    shifts = jnp.arange(16, dtype=jnp.uint32)
-    bits = (a[:, :, None] >> shifts[None, None, :]) & 1  # (B, 16, 16)
-    flat = bits.reshape(a.shape[0], 256)  # lsb-first
-    return jnp.flip(flat, axis=1).T  # (256, B) msb-first
+    return _bits_matrix_w(a, 256)
 
 
 # ---------------------------------------------------------------------------

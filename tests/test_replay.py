@@ -23,7 +23,7 @@ def small_chain():
 
 
 def test_run_blocks_matches_serial(small_chain, monkeypatch):
-    genesis, blocks, fresh_state = small_chain
+    genesis, blocks, fresh_state, _total, _calls = small_chain
     monkeypatch.setenv("PHANT_TPU_PREFETCH_SIGS", "8")  # force several windows
 
     serial = _fresh_chain(genesis, fresh_state)
@@ -43,7 +43,7 @@ def test_run_blocks_matches_serial(small_chain, monkeypatch):
 def test_run_blocks_invalid_signature_attributed(small_chain, monkeypatch):
     """A corrupt signature prefetched several blocks ahead must fail when
     ITS block runs, with earlier blocks already imported."""
-    genesis, blocks, fresh_state = small_chain
+    genesis, blocks, fresh_state, _total, _calls = small_chain
     monkeypatch.setenv("PHANT_TPU_PREFETCH_SIGS", "6")
     bad_idx = 7
     bad_tx = replace(blocks[bad_idx].transactions[1], r=12345)
@@ -69,7 +69,7 @@ def test_run_blocks_invalid_signature_attributed(small_chain, monkeypatch):
 
 
 def test_run_blocks_cpu_path(small_chain):
-    genesis, blocks, fresh_state = small_chain
+    genesis, blocks, fresh_state, _total, _calls = small_chain
     chain = _fresh_chain(genesis, fresh_state)
     results = chain.run_blocks(blocks)
     assert len(results) == len(blocks)
@@ -81,7 +81,7 @@ def test_run_blocks_survives_device_loss(small_chain, monkeypatch):
     drop / preemption) must degrade to CPU recovery, not sink the import."""
     import phant_tpu.ops.secp256k1_jax as secp_jax
 
-    genesis, blocks, fresh_state = small_chain
+    genesis, blocks, fresh_state, _total, _calls = small_chain
     monkeypatch.setenv("PHANT_TPU_PREFETCH_SIGS", "8")
 
     calls = {"n": 0}

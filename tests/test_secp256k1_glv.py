@@ -2,6 +2,8 @@
 adversarial R = m*G signatures (the only inputs that can reach the plain
 add formula's blind spot)."""
 
+import os
+
 import numpy as np
 import pytest
 
@@ -65,6 +67,9 @@ def test_adversarial_r_equals_gx_matches_cpu():
         rs.append(cpu.GX)
         ss.append(int.from_bytes(rng.bytes(32), "big") % cpu.N or 1)
         recids.append(int(rng.integers(0, 2)))
+    # pin the GLV path: this guards ITS blind-spot replay; an inherited
+    # PHANT_ECRECOVER_KERNEL=shamir would silently test the other kernel
+    os.environ["PHANT_ECRECOVER_KERNEL"] = "glv"
     got = ecrecover_batch(msgs, rs, ss, recids)
     for i in range(32):
         try:
