@@ -651,20 +651,27 @@ def _bench_state_root_inner(platform: str) -> dict:
             assert trie.root_hash() == expected
             cold_t.append(time.perf_counter() - t0)
 
-        trie_root_device(trie, plan)  # compile + device-residency
-        dev_t = []
-        for _ in range(reps):
-            t0 = time.perf_counter()
-            assert trie_root_device(trie, plan) == expected
-            dev_t.append(time.perf_counter() - t0)
-        return {
+        out = {
             "state_root_cpu_p50_ms": round(float(np.median(cpu_t)) * 1e3, 2),
-            "state_root_tpu_p50_ms": round(float(np.median(dev_t)) * 1e3, 2),
             "state_root_cpu_coldwalk_p50_ms": round(
                 float(np.median(cold_t)) * 1e3, 2
             ),
             "state_root_accounts": n_accounts,
         }
+        if platform != "cpu":
+            # the device recompute number only means something with a real
+            # accelerator attached; on a cpu fallback run the jax-cpu
+            # "device" path is just a minutes-long compile for a non-number
+            trie_root_device(trie, plan)  # compile + device-residency
+            dev_t = []
+            for _ in range(reps):
+                t0 = time.perf_counter()
+                assert trie_root_device(trie, plan) == expected
+                dev_t.append(time.perf_counter() - t0)
+            out["state_root_tpu_p50_ms"] = round(
+                float(np.median(dev_t)) * 1e3, 2
+            )
+        return out
     except Exception as e:
         return {"state_root_error": repr(e)[:200]}
 
@@ -693,7 +700,7 @@ def _build_replay_chain(n_blocks: int, txs_per_block: int):
     signer = TxSigner(chain_id)
     n_calls = max(txs_per_block // 2, 1)  # contract calls ride along
     keys = [
-        int.from_bytes(bytes([i + 1]) * 32, "big") % secp.N
+        int.from_bytes((i + 1).to_bytes(2, "big") * 16, "big") % secp.N
         for i in range(txs_per_block + n_calls)
     ]
     senders = []

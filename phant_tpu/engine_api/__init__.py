@@ -511,6 +511,7 @@ SUPPORTED_METHODS = (
     "engine_getPayloadBodiesByRangeV1",
     "engine_getPayloadBodiesByRangeV2",
     "engine_getClientVersionV1",  # * implemented
+    "phant_witnessEngineStats",  # * implemented (framework observability)
 )
 
 
@@ -546,6 +547,15 @@ def handle_request(blockchain, request: dict) -> Tuple[int, dict]:
         if method == "engine_getClientVersionV1":
             ver = get_client_version_v1_handler()
             return 200, {**base, "result": [ver.to_json()]}
+        if method == "phant_witnessEngineStats":
+            # framework observability (no reference analog): the memoized
+            # witness engine's cache effectiveness for the serving path
+            from phant_tpu.stateless import shared_witness_engine
+
+            return 200, {
+                **base,
+                "result": shared_witness_engine().stats_snapshot(),
+            }
     except Exception as e:  # malformed params etc.
         return 200, {**base, "error": {"code": -32602, "message": str(e)}}
     # unimplemented-but-known vs unknown (reference: res.status=500 main.zig:72)

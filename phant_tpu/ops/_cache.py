@@ -1,9 +1,17 @@
 """Persistent XLA compilation cache for the device kernels.
 
-The heavy kernels (the 256-step ecrecover ladder in particular) take
-minutes to compile but milliseconds to run; caching compiled programs
-under build/jax_cache makes every process after the first start instantly.
-Opt out with PHANT_NO_JAX_CACHE=1.
+The heavy kernels (the ecrecover ladders in particular) take minutes to
+compile but milliseconds to run; caching compiled programs under
+build/jax_cache makes every process after the first start instantly.
+
+jax SEGFAULTS — not raises — reading or writing a cache entry corrupted
+by concurrent writers, so every process class gets a SINGLE-WRITER dir:
+tests use a per-session tmpdir (tests/conftest.py), bench-contract
+subprocesses get per-test dirs, the driver dryrun uses
+build/jax_cache_dryrun, and only the bench/serving CLI use the shared
+build/jax_cache default. Point elsewhere with PHANT_JAX_CACHE; opt out
+entirely with PHANT_NO_COMPILE_CACHE=1 (PHANT_NO_JAX_CACHE is a legacy
+alias).
 """
 
 from __future__ import annotations
@@ -16,7 +24,11 @@ _configured = False
 
 def enable_compilation_cache() -> None:
     global _configured
-    if _configured or os.environ.get("PHANT_NO_JAX_CACHE"):
+    if (
+        _configured
+        or os.environ.get("PHANT_NO_JAX_CACHE", "0") not in ("", "0")
+        or os.environ.get("PHANT_NO_COMPILE_CACHE", "0") not in ("", "0")
+    ):
         return
     _configured = True
     try:

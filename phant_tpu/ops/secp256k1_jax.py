@@ -521,6 +521,29 @@ def glv_split(k: int) -> Tuple[int, int]:
     return k1, k2
 
 
+def pack_glv_inputs(
+    msg_hashes: Sequence[bytes], rs: Sequence[int], ss: Sequence[int]
+) -> Tuple[np.ndarray, np.ndarray]:
+    """(mags (B,4,9) u32, signs (B,4) u32) for `ecrecover_kernel_glv`: the
+    host-bigint half of recovery — r^-1 mod n, u1/u2, and the lambda
+    decomposition of each. Callers must have screened r, s into (0, N).
+    The single packing recipe shared by the dispatch path, the driver
+    dryrun, and the differential tests."""
+    B = len(msg_hashes)
+    mags = np.zeros((B, 4, _GLV_LIMBS), np.uint32)
+    signs = np.zeros((B, 4), np.uint32)
+    for i in range(B):
+        z = int.from_bytes(msg_hashes[i], "big") % N
+        r_inv = pow(rs[i], -1, N)
+        s1, s2 = glv_split((-z * r_inv) % N)
+        t1, t2 = glv_split((ss[i] * r_inv) % N)
+        mags[i] = _ints_to_limbs_w(
+            [abs(s1), abs(s2), abs(t1), abs(t2)], _GLV_LIMBS
+        )
+        signs[i] = [int(s1 < 0), int(s2 < 0), int(t1 < 0), int(t2 < 0)]
+    return mags, signs
+
+
 def _ints_to_limbs_w(xs: Sequence[int], width: int) -> np.ndarray:
     out = np.zeros((len(xs), width), np.uint32)
     for i, v in enumerate(xs):
@@ -806,23 +829,10 @@ def _dispatch_glv(out, device_idx, msg_hashes, rs, ss, recovery_ids):
     from phant_tpu.crypto.secp256k1 import SignatureError, recover_pubkey
 
     ship: List[int] = []
-    mags_l: List[Tuple[int, int, int, int]] = []
-    signs_l: List[Tuple[int, int, int, int]] = []
     for i in device_idx:
-        r, s = rs[i], ss[i]
-        if not (0 < r < N and 0 < s < N):
+        if not (0 < rs[i] < N and 0 < ss[i] < N):
             out[i] = None
             continue
-        z = int.from_bytes(msg_hashes[i], "big") % N
-        r_inv = pow(r, -1, N)
-        u1 = (-z * r_inv) % N
-        u2 = (s * r_inv) % N  # never 0: s and r_inv are units mod prime N
-        s1, s2 = glv_split(u1)
-        t1, t2 = glv_split(u2)
-        mags_l.append((abs(s1), abs(s2), abs(t1), abs(t2)))
-        signs_l.append(
-            (int(s1 < 0), int(s2 < 0), int(t1 < 0), int(t2 < 0))
-        )
         ship.append(i)
     if not ship:
         return lambda: out
@@ -830,12 +840,15 @@ def _dispatch_glv(out, device_idx, msg_hashes, rs, ss, recovery_ids):
     pad = _bucket_pad(len(ship)) - len(ship)
     r_arr = ints_to_limbs([rs[i] for i in ship] + [1] * pad)
     par = np.array([recovery_ids[i] & 1 for i in ship] + [0] * pad, np.uint32)
+    mags_s, signs_s = pack_glv_inputs(
+        [msg_hashes[i] for i in ship],
+        [rs[i] for i in ship],
+        [ss[i] for i in ship],
+    )
     mags = np.zeros((len(ship) + pad, 4, _GLV_LIMBS), np.uint32)
-    for k, m4 in enumerate(mags_l):
-        mags[k] = _ints_to_limbs_w(list(m4), _GLV_LIMBS)
+    mags[: len(ship)] = mags_s
     signs = np.zeros((len(ship) + pad, 4), np.uint32)
-    if signs_l:
-        signs[: len(signs_l)] = np.asarray(signs_l, np.uint32)
+    signs[: len(ship)] = signs_s
     digest, valid, degenerate = ecrecover_kernel_glv(
         jnp.asarray(r_arr), jnp.asarray(par), jnp.asarray(mags), jnp.asarray(signs)
     )

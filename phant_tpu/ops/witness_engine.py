@@ -363,3 +363,13 @@ class WitnessEngine:
     def verify(self, state_root: bytes, nodes: Sequence[bytes]) -> bool:
         """Single-witness convenience wrapper (the Engine API path)."""
         return bool(self.verify_batch([(state_root, list(nodes))])[0])
+
+    def stats_snapshot(self) -> dict:
+        """Counters + derived cache-effectiveness numbers (the public
+        surface behind the phant_witnessEngineStats RPC)."""
+        st = dict(self.stats)
+        seen = st.get("hashed", 0) + st.get("hits", 0)
+        st["hit_rate"] = round(st.get("hits", 0) / seen, 4) if seen else 0.0
+        st["interned_nodes"] = len(self._row_of_bytes)
+        st["interned_digests"] = len(self._refid_of_digest)
+        return st

@@ -1,6 +1,7 @@
 """Multi-chip / multi-host scaling (device meshes + sharded kernels)."""
 
 from phant_tpu.parallel.mesh import (
+    ecrecover_glv_sharded,
     ecrecover_sharded,
     init_distributed,
     make_mesh,
@@ -10,6 +11,7 @@ from phant_tpu.parallel.mesh import (
 )
 
 __all__ = [
+    "ecrecover_glv_sharded",
     "ecrecover_sharded",
     "init_distributed",
     "make_mesh",

@@ -1,8 +1,8 @@
-"""Persistent XLA compilation cache (shared by bench.py and the tests).
-
-The kernels are identical across processes; recompiling the 256-step
-ecrecover ladder per run costs minutes. Best-effort: older jax without the
-persistent cache just runs uncached."""
+"""Persistent XLA compilation cache (shared by bench.py and the driver
+entry points). Thin wrapper over the single implementation in
+phant_tpu/ops/_cache.py — see its docstring for the opt-out contract
+(PHANT_NO_COMPILE_CACHE=1; tests set it because concurrent writers can
+corrupt entries and jax segfaults on a corrupt cache)."""
 
 from __future__ import annotations
 
@@ -10,16 +10,10 @@ import os
 
 
 def enable_compile_cache(cache_dir: str | None = None) -> None:
-    try:
-        import jax
+    if cache_dir:
+        # an explicit dir is an isolation request — it outranks any
+        # inherited PHANT_JAX_CACHE
+        os.environ["PHANT_JAX_CACHE"] = os.path.abspath(cache_dir)
+    from phant_tpu.ops._cache import enable_compilation_cache
 
-        cache = cache_dir or os.environ.get(
-            "PHANT_JAX_CACHE",
-            os.path.join(os.path.dirname(__file__), "..", "..", "build", "jax_cache"),
-        )
-        cache = os.path.abspath(cache)
-        os.makedirs(cache, exist_ok=True)
-        jax.config.update("jax_compilation_cache_dir", cache)
-        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
-    except Exception:
-        pass
+    enable_compilation_cache()

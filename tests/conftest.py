@@ -20,6 +20,18 @@ if os.environ.get("PHANT_TEST_TPU", "0") in ("", "0"):
     # otherwise re-route tpu-backend differential tests to the CPU path;
     # here the CPU-mesh jax run IS the point
     os.environ["PHANT_ALLOW_JAX_CPU"] = "1"
+    # per-session PRIVATE compile cache: jax segfaults (not raises) on a
+    # cache entry corrupted by concurrent writers, so the test process must
+    # never share build/jax_cache with bench subprocesses or other runs;
+    # an isolated dir keeps the session single-writer AND fast
+    if "PHANT_JAX_CACHE" not in os.environ:
+        import atexit
+        import shutil
+        import tempfile
+
+        _cache_dir = tempfile.mkdtemp(prefix="phant-jax-cache-")
+        os.environ["PHANT_JAX_CACHE"] = _cache_dir
+        atexit.register(shutil.rmtree, _cache_dir, ignore_errors=True)
     os.environ.setdefault("PHANT_TPU_FORCE_TRIE", "1")  # bypass the link
     # cost model: differential tests must exercise the device dispatch even
     # though a CPU-mesh "link" never pays off for tiny tries

@@ -16,11 +16,16 @@ import pytest
 
 
 @pytest.mark.slow
-def test_bench_prints_one_json_line_with_schema():
+def test_bench_prints_one_json_line_with_schema(tmp_path):
     env = dict(os.environ)
     env.pop("PALLAS_AXON_POOL_IPS", None)
     env.update(
         JAX_PLATFORMS="cpu",
+        # isolated single-writer compile cache: conftest globally disables
+        # the shared one for pytest (concurrent corruption -> jax segfault),
+        # but an uncached bench subprocess recompiles for minutes
+        PHANT_NO_COMPILE_CACHE="0",
+        PHANT_JAX_CACHE=str(tmp_path / "jax_cache"),
         PHANT_BENCH_WARM="8",
         PHANT_BENCH_BLOCKS="16",
         PHANT_BENCH_TRIE="1024",
@@ -34,7 +39,7 @@ def test_bench_prints_one_json_line_with_schema():
         [sys.executable, "bench.py"],
         capture_output=True,
         text=True,
-        timeout=900,
+        timeout=1200,
         env=env,
         cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
     )
@@ -62,13 +67,15 @@ def test_bench_prints_one_json_line_with_schema():
 
 
 @pytest.mark.slow
-def test_bench_global_deadline_always_prints_json():
+def test_bench_global_deadline_always_prints_json(tmp_path):
     """A hung tunnel must still yield the driver a JSON line: force the
     global deadline to fire almost immediately and check the fallback."""
     env = dict(os.environ)
     env.pop("PALLAS_AXON_POOL_IPS", None)
     env.update(
         JAX_PLATFORMS="cpu",
+        PHANT_NO_COMPILE_CACHE="0",
+        PHANT_JAX_CACHE=str(tmp_path / "jax_cache"),
         PHANT_BENCH_WARM="8",
         PHANT_BENCH_BLOCKS="16",
         PHANT_BENCH_TRIE="1024",

@@ -259,6 +259,33 @@ def test_client_version():
     assert code == 200 and resp["result"][0]["code"] == "PH"
 
 
+def test_witness_engine_stats_rpc():
+    chain = _fresh_chain()
+    code, resp = handle_request(
+        chain, {"id": 4, "method": "phant_witnessEngineStats", "params": []}
+    )
+    assert code == 200
+    st = resp["result"]
+    for key in ("hashed", "hits", "evictions", "hit_rate", "interned_nodes"):
+        assert key in st, st
+    # the shared engine is live: verifying a witness moves the counters
+    from phant_tpu import rlp
+    from phant_tpu.crypto.keccak import keccak256
+    from phant_tpu.mpt.mpt import Trie
+    from phant_tpu.mpt.proof import generate_proof
+    from phant_tpu.stateless import verify_witness_nodes
+
+    t = Trie()
+    for i in range(32):
+        t.put(keccak256(bytes([i])), rlp.encode(rlp.encode_uint(i + 1)))
+    nodes = list(dict.fromkeys(generate_proof(t, keccak256(bytes([0])))))
+    assert verify_witness_nodes(t.root_hash(), nodes)
+    _code, resp2 = handle_request(
+        chain, {"id": 5, "method": "phant_witnessEngineStats", "params": []}
+    )
+    assert resp2["result"]["hashed"] >= st["hashed"] + len(nodes) - 1
+
+
 def test_http_server_roundtrip():
     """Full HTTP POST round-trip (reference: main.zig:143-149 via httpz)."""
     chain = _fresh_chain()

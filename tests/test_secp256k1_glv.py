@@ -56,7 +56,7 @@ def test_kernel_flags_engineered_collision():
     assert bool(np.asarray(degenerate)[0]), "engineered collision not flagged"
 
 
-def test_adversarial_r_equals_gx_matches_cpu():
+def test_adversarial_r_equals_gx_matches_cpu(monkeypatch):
     """Signatures whose r is GX (attacker knows dlog of R): whatever the
     degenerate flags say, the public API must agree with the exact CPU
     recovery for every (z, s) tried."""
@@ -69,7 +69,7 @@ def test_adversarial_r_equals_gx_matches_cpu():
         recids.append(int(rng.integers(0, 2)))
     # pin the GLV path: this guards ITS blind-spot replay; an inherited
     # PHANT_ECRECOVER_KERNEL=shamir would silently test the other kernel
-    os.environ["PHANT_ECRECOVER_KERNEL"] = "glv"
+    monkeypatch.setenv("PHANT_ECRECOVER_KERNEL", "glv")
     got = ecrecover_batch(msgs, rs, ss, recids)
     for i in range(32):
         try:
