@@ -206,6 +206,12 @@ struct PhantTxContext {
   uint8_t gas_price[32];
   uint8_t prev_randao[32];
   uint8_t base_fee[32];
+  // EVM revision: 0 = Shanghai, 1 = Cancun (the reference hardcodes
+  // EVMC_SHANGHAI, src/blockchain/vm.zig:472; this core fork-dispatches)
+  uint64_t revision;
+  uint8_t blob_base_fee[32];          // EIP-7516
+  const uint8_t* blob_hashes;         // EIP-4844: n x 32 bytes, may be null
+  uint64_t n_blob_hashes;
 };
 
 // kinds for PhantMsg / the host `call` callback
@@ -260,6 +266,16 @@ struct PhantHost {
   void (*add_refund)(void*, int64_t delta);
   void (*selfdestruct)(void*, const uint8_t addr[20], const uint8_t beneficiary[20]);
   void (*call)(void*, const PhantMsg* msg, PhantResult* result);
+  // EIP-1153 transient storage (Cancun); appended so pre-Cancun embedders'
+  // vtable layout is a strict prefix
+  void (*get_transient)(void*, const uint8_t addr[20], const uint8_t key[32], uint8_t out[32]);
+  void (*set_transient)(void*, const uint8_t addr[20], const uint8_t key[32], const uint8_t val[32]);
+  // optional per-instruction tracer (NULL = tracing off, zero overhead
+  // beyond one branch). The reference compiles evmone's tracing.cpp into
+  // its binary but never installs a tracer (build.zig:118, SURVEY §5);
+  // this is the equivalent debugging surface, actually wired up.
+  void (*trace)(void*, uint64_t pc, int32_t op, int64_t gas, int32_t depth,
+                int32_t stack_size);
 };
 
 }  // extern "C"
@@ -394,6 +410,9 @@ inline bool size_cost(const U256& size_u, int64_t per_word, int64_t* out) {
 Halt Interp::run() {
   while (pc < code_len) {
     uint8_t op = code[pc];
+    if (host->trace)
+      host->trace(host->ctx, pc, (int32_t)op, gas, msg->depth,
+                  (int32_t)stack.size());
     ++pc;
 
     // PUSH1..PUSH32
@@ -924,6 +943,24 @@ Halt Interp::run() {
         GAS(2);
         PUSH(u_from_be(txc->base_fee));
         break;
+      case 0x49: {  // BLOBHASH (EIP-4844, Cancun)
+        if (txc->revision < 1) return Halt::kFail;
+        GAS(3);
+        POP1(idx_u);
+        uint64_t idx;
+        if (u_fits64(idx_u, &idx) && idx < txc->n_blob_hashes &&
+            txc->blob_hashes != nullptr) {
+          PUSH(u_from_be(txc->blob_hashes + 32 * idx));
+        } else {
+          PUSH(u_zero());
+        }
+        break;
+      }
+      case 0x4A:  // BLOBBASEFEE (EIP-7516, Cancun)
+        if (txc->revision < 1) return Halt::kFail;
+        GAS(2);
+        PUSH(u_from_be(txc->blob_base_fee));
+        break;
 
       case 0x50: {  // POP
         GAS(2);
@@ -1049,6 +1086,45 @@ Halt Interp::run() {
       case 0x5B:  // JUMPDEST
         GAS(1);
         break;
+      case 0x5C: {  // TLOAD (EIP-1153, Cancun)
+        if (txc->revision < 1) return Halt::kFail;
+        GAS(kWarmSload);
+        POP1(slot);
+        uint8_t key[32], val[32];
+        u_to_be(slot, key);
+        host->get_transient(host->ctx, self_addr, key, val);
+        PUSH(u_from_be(val));
+        break;
+      }
+      case 0x5D: {  // TSTORE (EIP-1153, Cancun)
+        if (txc->revision < 1) return Halt::kFail;
+        if (msg->is_static) return Halt::kFail;
+        GAS(kWarmSload);
+        POP2(slot, val_u);
+        uint8_t key[32], val[32];
+        u_to_be(slot, key);
+        u_to_be(val_u, val);
+        host->set_transient(host->ctx, self_addr, key, val);
+        break;
+      }
+      case 0x5E: {  // MCOPY (EIP-5656, Cancun)
+        if (txc->revision < 1) return Halt::kFail;
+        POP3(dst_u, src_u, size_u);
+        int64_t words_cost;
+        if (!size_cost(size_u, kCopyWordGas, &words_cost)) return Halt::kFail;
+        GAS(3 + words_cost);
+        if (!u_is_zero(size_u)) {
+          // one expansion covering both ranges (charge on the larger end)
+          const U256& far = u_cmp(dst_u, src_u) >= 0 ? dst_u : src_u;
+          if (!expand(far, size_u)) return Halt::kFail;
+          uint64_t dst = 0, src = 0, size = 0;
+          u_fits64(dst_u, &dst);
+          u_fits64(src_u, &src);
+          u_fits64(size_u, &size);
+          std::memmove(mem.data() + dst, mem.data() + src, size);
+        }
+        break;
+      }
       case 0x5F:  // PUSH0 (EIP-3855, Shanghai)
         GAS(2);
         PUSH(u_zero());

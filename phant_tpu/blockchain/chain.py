@@ -28,9 +28,12 @@ from phant_tpu.state.statedb import StateDB
 from phant_tpu.types.block import Block, BlockHeader
 from phant_tpu.types.receipt import Receipt, logs_bloom
 from phant_tpu.types.transaction import (
+    BlobTx,
     FeeMarketTx,
     Transaction,
+    VERSIONED_HASH_VERSION_KZG,
     access_list_of,
+    blob_gas_of,
     effective_gas_price,
     max_fee_per_gas,
 )
@@ -208,6 +211,9 @@ class Blockchain:
         self.fork.update_parent_block_hash(
             self.parent_header.block_number, self.parent_header.hash()
         )
+        # fork-scoped system updates (EIP-4788 beacon root under Cancun);
+        # journaled, so an invalid block rolls them back with everything else
+        self.fork.on_block_start(block.header)
 
         result = self.apply_body(block, senders)
 
@@ -242,9 +248,55 @@ class Blockchain:
 
     # ------------------------------------------------------------------
 
+    def cancun_active(self, header: BlockHeader) -> bool:
+        """Cancun dispatch: the chain config's schedule when present, else
+        the header's own blob-gas fields (fixtures and synthetic chains are
+        self-describing). The reference pins EVMC_SHANGHAI with a TODO
+        (src/blockchain/vm.zig:472); this is that TODO done."""
+        if self.config is not None:
+            name = self.config.fork_at(header.block_number, header.timestamp)
+            return name in ("cancun", "prague", "osaka")
+        return header.excess_blob_gas is not None
+
+    def blob_schedule(self, header: BlockHeader) -> tuple:
+        """(max_blob_gas, target_blob_gas, fee_update_fraction) for this
+        block — EIP-7691 raised all three at Prague. Config-less chains
+        (fixtures, synthetic benches) derive the schedule from the fork
+        instance they were constructed with."""
+        from phant_tpu.blockchain.fork import PragueFork
+
+        if self.config is not None:
+            name = self.config.fork_at(header.block_number, header.timestamp)
+        elif isinstance(self.fork, PragueFork):
+            name = "prague"
+        else:
+            name = "cancun"
+        return G.blob_schedule(name)
+
     def validate_block_header(self, header: BlockHeader) -> None:
-        """(reference: blockchain.zig:100-138)"""
+        """(reference: blockchain.zig:100-138; the blob-gas rules are
+        EIP-4844, beyond the reference's Shanghai ceiling)"""
         parent = self.parent_header
+        if self.cancun_active(header):
+            if header.blob_gas_used is None or header.excess_blob_gas is None:
+                raise BlockError("cancun header missing blob gas fields")
+            max_blob_gas, target_blob_gas, _frac = self.blob_schedule(header)
+            if header.blob_gas_used > max_blob_gas:
+                raise BlockError("blob gas used above block maximum")
+            if header.blob_gas_used % G.GAS_PER_BLOB != 0:
+                raise BlockError("blob gas used not a blob multiple")
+            expected_excess = G.calc_excess_blob_gas(
+                parent.excess_blob_gas or 0,
+                parent.blob_gas_used or 0,
+                target=target_blob_gas,
+            )
+            if header.excess_blob_gas != expected_excess:
+                raise BlockError(
+                    f"excess blob gas mismatch: header {header.excess_blob_gas}, "
+                    f"expected {expected_excess}"
+                )
+        elif header.blob_gas_used is not None or header.excess_blob_gas is not None:
+            raise BlockError("blob gas fields before cancun")
         if header.base_fee_per_gas is None:
             raise BlockError("missing base fee (pre-London unsupported)")
         expected_base_fee = calculate_base_fee(
@@ -306,9 +358,26 @@ class Blockchain:
                     f"invalid signature: unrecoverable signature at tx index {bad[0]}"
                 )
 
+        # block-constant fork context computed ONCE (the schedule scan and
+        # the fake_exponential blob fee are per-header facts; the tx loop
+        # is the replay hot path)
+        cancun = self.cancun_active(header)
+        if cancun:
+            max_blob_gas, _target, fee_fraction = self.blob_schedule(header)
+            bbf = G.blob_base_fee(header.excess_blob_gas or 0, fee_fraction)
+        else:
+            max_blob_gas, bbf = 0, 0
+        blob_gas_used = 0
         for tx, sender in zip(block.transactions, senders):
-            self.check_transaction(tx, header, gas_available, sender)
-            gas_used, tx_logs, succeeded = self.process_transaction(tx, sender, header)
+            self.check_transaction(
+                tx, header, gas_available, sender, cancun=cancun, blob_base_fee=bbf
+            )
+            blob_gas_used += blob_gas_of(tx)
+            if cancun and blob_gas_used > max_blob_gas:
+                raise BlockError("block blob gas above maximum")
+            gas_used, tx_logs, succeeded = self.process_transaction(
+                tx, sender, header, cancun=cancun, blob_base_fee=bbf
+            )
             gas_available -= gas_used
             cumulative_gas += gas_used
             receipts.append(
@@ -320,6 +389,12 @@ class Blockchain:
                 )
             )
             all_logs.extend(tx_logs)
+
+        if cancun and blob_gas_used != (header.blob_gas_used or 0):
+            raise BlockError(
+                f"blob gas used mismatch: computed {blob_gas_used}, "
+                f"header {header.blob_gas_used}"
+            )
 
         # withdrawals (reference: blockchain.zig:193-196)
         if block.withdrawals:
@@ -338,14 +413,24 @@ class Blockchain:
     # ------------------------------------------------------------------
 
     def check_transaction(
-        self, tx: Transaction, header: BlockHeader, gas_available: int, sender: bytes
+        self,
+        tx: Transaction,
+        header: BlockHeader,
+        gas_available: int,
+        sender: bytes,
+        cancun: Optional[bool] = None,
+        blob_base_fee: Optional[int] = None,
     ) -> None:
         """(reference: blockchain.zig:237-260 + validateTransaction :345-353;
-        sender recovery itself happens batched in apply_body)"""
+        sender recovery itself happens batched in apply_body). `cancun` /
+        `blob_base_fee` are block constants apply_body precomputes; direct
+        callers may omit them."""
+        if cancun is None:
+            cancun = self.cancun_active(header)
         if tx.gas_limit > gas_available:
             raise BlockError("tx gas limit exceeds available block gas")
         base_fee = header.base_fee_per_gas or 0
-        if isinstance(tx, FeeMarketTx):
+        if isinstance(tx, (FeeMarketTx, BlobTx)):
             if tx.max_fee_per_gas < tx.max_priority_fee_per_gas:
                 raise BlockError("max fee below priority fee")
             if tx.max_fee_per_gas < base_fee:
@@ -353,6 +438,26 @@ class Blockchain:
         else:
             if tx.gas_price < base_fee:
                 raise BlockError("gas price below base fee")
+
+        blob_fee = 0
+        if isinstance(tx, BlobTx):
+            # EIP-4844 validity (no reference analog — type 3 postdates it)
+            if not cancun:
+                raise BlockError("blob tx before cancun")
+            if tx.to is None:
+                raise BlockError("blob tx cannot create")
+            if not tx.blob_versioned_hashes:
+                raise BlockError("blob tx without blobs")
+            for h in tx.blob_versioned_hashes:
+                if len(h) != 32 or h[0] != VERSIONED_HASH_VERSION_KZG:
+                    raise BlockError("bad blob versioned hash version")
+            if blob_base_fee is None:
+                blob_base_fee = G.blob_base_fee(
+                    header.excess_blob_gas or 0, self.blob_schedule(header)[2]
+                )
+            if tx.max_fee_per_blob_gas < blob_base_fee:
+                raise BlockError("max blob fee below blob base fee")
+            blob_fee = tx.blob_gas() * tx.max_fee_per_blob_gas
 
         # intrinsic validity (reference: validateTransaction blockchain.zig:345-353)
         is_create = tx.to is None
@@ -370,7 +475,7 @@ class Blockchain:
             raise BlockError(f"nonce mismatch: tx {tx.nonce}, account {nonce}")
         if sender_acct is not None and sender_acct.code:
             raise BlockError("sender is not EOA (EIP-3607)")
-        max_cost = tx.gas_limit * max_fee_per_gas(tx) + tx.value
+        max_cost = tx.gas_limit * max_fee_per_gas(tx) + tx.value + blob_fee
         balance = sender_acct.balance if sender_acct else 0
         if balance < max_cost:
             raise BlockError("insufficient sender balance for gas + value")
@@ -378,14 +483,34 @@ class Blockchain:
     # ------------------------------------------------------------------
 
     def process_transaction(
-        self, tx: Transaction, sender: bytes, header: BlockHeader
+        self,
+        tx: Transaction,
+        sender: bytes,
+        header: BlockHeader,
+        cancun: Optional[bool] = None,
+        blob_base_fee: Optional[int] = None,
     ) -> Tuple[int, list, bool]:
-        """(reference: blockchain.zig:262-343)"""
+        """(reference: blockchain.zig:262-343). `cancun` / `blob_base_fee`
+        are block constants apply_body precomputes; direct callers may omit
+        them."""
         state = self.state
         state.start_tx()
         base_fee = header.base_fee_per_gas or 0
         gas_price = effective_gas_price(tx, base_fee)
         priority_fee = gas_price - base_fee
+        if cancun is None:
+            cancun = self.cancun_active(header)
+        if blob_base_fee is None:
+            blob_base_fee = (
+                G.blob_base_fee(
+                    header.excess_blob_gas or 0, self.blob_schedule(header)[2]
+                )
+                if cancun
+                else 0
+            )
+        blob_fee_rate = blob_base_fee
+
+        from phant_tpu.evm.message import REVISION_CANCUN, REVISION_SHANGHAI
 
         env = Environment(
             state=state,
@@ -399,10 +524,19 @@ class Blockchain:
             base_fee=base_fee,
             chain_id=self.chain_id,
             block_hash_fn=self.fork.get_block_hash,
+            revision=REVISION_CANCUN if cancun else REVISION_SHANGHAI,
+            blob_hashes=(
+                tx.blob_versioned_hashes if isinstance(tx, BlobTx) else ()
+            ),
+            blob_base_fee=blob_fee_rate,
         )
 
-        # buy gas, bump nonce (reference: blockchain.zig:266-301)
+        # buy gas, bump nonce (reference: blockchain.zig:266-301); the blob
+        # fee (EIP-4844) is burned up front at the BLOCK's blob base fee and
+        # never refunded — it is not execution gas
         state.sub_balance(sender, tx.gas_limit * gas_price)
+        if isinstance(tx, BlobTx):
+            state.sub_balance(sender, tx.blob_gas() * blob_fee_rate)
         state.increment_nonce(sender)
 
         # EIP-2929 warm-set prefill incl. EIP-3651 warm coinbase

@@ -29,13 +29,18 @@ HISTORY_CONTRACT_CODE = bytes.fromhex(
 
 
 class Fork:
-    """BLOCKHASH provider interface (reference: fork.zig:9-13)."""
+    """BLOCKHASH provider interface (reference: fork.zig:9-13), extended
+    with a block-start hook for fork-scoped system updates (EIP-4788
+    beacon roots under Cancun; the reference has no Cancun fork)."""
 
     def update_parent_block_hash(self, number: int, block_hash: bytes) -> None:
         raise NotImplementedError
 
     def get_block_hash(self, number: int) -> bytes:
         raise NotImplementedError
+
+    def on_block_start(self, header) -> None:
+        """System-contract updates at the start of block processing."""
 
 
 class FrontierFork(Fork):
@@ -61,16 +66,28 @@ def fork_for(config, state: StateDB, block_number: int, timestamp: int) -> "Fork
     name = config.fork_at(block_number, timestamp)
     if name in ("prague", "osaka"):
         return PragueFork(state)
+    if name == "cancun":
+        return CancunFork(state)
     return FrontierFork()
 
 
 class PragueFork(Fork):
     """EIP-2935: ancestor hashes in the history system contract
-    (reference: prague.zig:26-52; deployContract prague.zig:54-57)."""
+    (reference: prague.zig:26-52; deployContract prague.zig:54-57).
+    Prague retains Cancun's EIP-4788 beacon-root update — on_block_start
+    writes the same twin ring slots (the reference's prague.zig covers
+    only the BLOCKHASH experiment)."""
 
     def __init__(self, state: StateDB):
         self.state = state
         self.deploy_contract()
+        if not state.get_code(BEACON_ROOTS_ADDRESS):
+            state.create_account(BEACON_ROOTS_ADDRESS)
+            state.set_nonce(BEACON_ROOTS_ADDRESS, 1)
+            state.set_code(BEACON_ROOTS_ADDRESS, BEACON_ROOTS_CODE)
+
+    def on_block_start(self, header) -> None:
+        _write_beacon_root(self.state, header)
 
     def deploy_contract(self) -> None:
         if not self.state.get_code(HISTORY_STORAGE_ADDRESS):
@@ -89,3 +106,53 @@ class PragueFork(Fork):
     def get_block_hash(self, number: int) -> bytes:
         value = self.state.get_storage(HISTORY_STORAGE_ADDRESS, number % HISTORY_SERVE_WINDOW)
         return value.to_bytes(32, "big")
+
+
+# --- Cancun (no reference analog: its fork set stops at Shanghai/Prague
+# BLOCKHASH experiments, src/blockchain/forks/) ------------------------------
+
+BEACON_ROOTS_ADDRESS = bytes.fromhex("000f3df6d732807ef1319fb7b8bb8522d0beac02")
+BEACON_ROOTS_BUFFER = 8191
+
+# EIP-4788 deployed runtime bytecode (from the EIP's deployment tx): caller
+# == 0xff..fe writes (timestamp, root) into the twin ring buffers; anyone
+# else calls with a 32-byte timestamp and gets the matching root or reverts.
+BEACON_ROOTS_CODE = bytes.fromhex(
+    "3373fffffffffffffffffffffffffffffffffffffffe14604d57602036146024"
+    "575f5ffd5b5f35801560495762001fff810690815414603c575f5ffd5b62001f"
+    "ff01545f5260205ff35b5f5ffd5b62001fff42064281555f359062001fff0155"
+    "00"
+)
+
+
+def _write_beacon_root(state: StateDB, header) -> None:
+    """The EIP-4788 system call's storage effect:
+    storage[ts % 8191] = ts, storage[ts % 8191 + 8191] = root."""
+    root = getattr(header, "parent_beacon_block_root", None)
+    if root is None:
+        return
+    ts = header.timestamp
+    slot = ts % BEACON_ROOTS_BUFFER
+    state.set_storage(BEACON_ROOTS_ADDRESS, slot, ts)
+    state.set_storage(
+        BEACON_ROOTS_ADDRESS,
+        slot + BEACON_ROOTS_BUFFER,
+        int.from_bytes(root, "big"),
+    )
+
+
+class CancunFork(FrontierFork):
+    """Cancun: Frontier-style BLOCKHASH ring (EIP-2935 activates later, in
+    Prague) plus the EIP-4788 parent-beacon-root system update at block
+    start."""
+
+    def __init__(self, state: StateDB):
+        super().__init__()
+        self.state = state
+        if not state.get_code(BEACON_ROOTS_ADDRESS):
+            state.create_account(BEACON_ROOTS_ADDRESS)
+            state.set_nonce(BEACON_ROOTS_ADDRESS, 1)
+            state.set_code(BEACON_ROOTS_ADDRESS, BEACON_ROOTS_CODE)
+
+    def on_block_start(self, header) -> None:
+        _write_beacon_root(self.state, header)

@@ -212,6 +212,9 @@ class ExecutionPayload:
     withdrawals: Optional[Tuple[Withdrawal, ...]] = None
     blob_gas_used: Optional[int] = None
     excess_blob_gas: Optional[int] = None
+    # V3 (Cancun): passed beside the payload in newPayloadV3, but part of
+    # the header (and thus of blockHash)
+    parent_beacon_block_root: Optional[bytes] = None
 
     def to_block(self) -> Block:
         """Build a Block, deriving tx/withdrawal MPT roots for the header
@@ -243,6 +246,7 @@ class ExecutionPayload:
             withdrawals_root=wd_root,
             blob_gas_used=self.blob_gas_used,
             excess_blob_gas=self.excess_blob_gas,
+            parent_beacon_block_root=self.parent_beacon_block_root,
         )
         return Block(
             header=header,
@@ -296,6 +300,43 @@ def payload_from_json(params: dict) -> ExecutionPayload:
 
 # ---------------------------------------------------------------------------
 # Handlers
+
+
+def new_payload_v3_handler(
+    blockchain,
+    payload: ExecutionPayload,
+    expected_blob_versioned_hashes,
+    parent_beacon_block_root: bytes,
+) -> PayloadStatusV1:
+    """`engine_newPayloadV3` (Cancun; beyond the reference, whose method
+    list stops at listing it, main.zig:24-54): folds the side-channel
+    parentBeaconBlockRoot into the header, checks the CL's expected blob
+    versioned hashes against the concatenated tx blob hashes, then runs
+    the common validation path."""
+    from dataclasses import replace as drep
+
+    from phant_tpu.types.transaction import BlobTx
+
+    if payload.blob_gas_used is None or payload.excess_blob_gas is None:
+        # required V3 payload fields — a payload without them must not
+        # silently execute under pre-Cancun rules
+        raise ValueError(
+            "engine_newPayloadV3 payload requires blobGasUsed and "
+            "excessBlobGas"
+        )
+    payload = drep(payload, parent_beacon_block_root=parent_beacon_block_root)
+    got_hashes = [
+        h
+        for tx in payload.transactions
+        if isinstance(tx, BlobTx)
+        for h in tx.blob_versioned_hashes
+    ]
+    if list(expected_blob_versioned_hashes) != got_hashes:
+        return PayloadStatusV1(
+            status="INVALID",
+            validation_error="blob versioned hashes mismatch",
+        )
+    return new_payload_v2_handler(blockchain, payload)
 
 
 def new_payload_v2_handler(blockchain, payload: ExecutionPayload) -> PayloadStatusV1:
@@ -496,7 +537,7 @@ SUPPORTED_METHODS = (
     "engine_getBlobsV1",
     "engine_newPayloadV1",
     "engine_newPayloadV2",  # * implemented
-    "engine_newPayloadV3",
+    "engine_newPayloadV3",  # * implemented (Cancun; beyond reference)
     "engine_newPayloadV4",
     "engine_newPayloadWithWitnessV1",
     "engine_newPayloadWithWitnessV2",
@@ -534,6 +575,18 @@ def handle_request(blockchain, request: dict) -> Tuple[int, dict]:
                 payload = payload_from_json(request["params"][0])
             with metrics.phase("engine_api.new_payload"):
                 status = new_payload_v2_handler(blockchain, payload)
+            return 200, {**base, "result": status.to_json()}
+        if method == "engine_newPayloadV3":
+            with metrics.phase("engine_api.decode_payload"):
+                payload = payload_from_json(request["params"][0])
+                expected_hashes = [
+                    hex_to_hash(h) for h in request["params"][1]
+                ]
+                beacon_root = hex_to_hash(request["params"][2])
+            with metrics.phase("engine_api.new_payload"):
+                status = new_payload_v3_handler(
+                    blockchain, payload, expected_hashes, beacon_root
+                )
             return 200, {**base, "result": status.to_json()}
         if method == "engine_executeStatelessPayloadV1":
             with metrics.phase("engine_api.decode_payload"):

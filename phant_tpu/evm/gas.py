@@ -82,3 +82,67 @@ def intrinsic_gas(data: bytes, is_create: bool, access_list, init_code_len: int 
         gas += TX_ACCESS_LIST_ADDRESS_COST
         gas += TX_ACCESS_LIST_STORAGE_KEY_COST * len(keys)
     return gas
+
+# --- Cancun (EIP-4844 / 1153 / 5656 / 7516; beyond the reference's
+# Shanghai pin, src/blockchain/vm.zig:472) ---
+TLOAD_GAS = 100
+TSTORE_GAS = 100
+BLOBHASH_GAS = 3
+BLOBBASEFEE_GAS = 2
+GAS_PER_BLOB = 1 << 17
+TARGET_BLOB_GAS_PER_BLOCK = 3 * GAS_PER_BLOB
+MAX_BLOB_GAS_PER_BLOCK = 6 * GAS_PER_BLOB
+MIN_BLOB_BASE_FEE = 1
+BLOB_BASE_FEE_UPDATE_FRACTION = 3_338_477
+
+# Prague blob schedule (EIP-7691: throughput raised to 6 target / 9 max,
+# steeper fee response)
+PRAGUE_TARGET_BLOB_GAS_PER_BLOCK = 6 * GAS_PER_BLOB
+PRAGUE_MAX_BLOB_GAS_PER_BLOCK = 9 * GAS_PER_BLOB
+PRAGUE_BLOB_BASE_FEE_UPDATE_FRACTION = 5_007_716
+
+
+def blob_schedule(fork_name: str) -> tuple:
+    """(max_blob_gas, target_blob_gas, base_fee_update_fraction) for the
+    active fork — EIP-7691 changed all three at Prague."""
+    if fork_name in ("prague", "osaka"):
+        return (
+            PRAGUE_MAX_BLOB_GAS_PER_BLOCK,
+            PRAGUE_TARGET_BLOB_GAS_PER_BLOCK,
+            PRAGUE_BLOB_BASE_FEE_UPDATE_FRACTION,
+        )
+    return (
+        MAX_BLOB_GAS_PER_BLOCK,
+        TARGET_BLOB_GAS_PER_BLOCK,
+        BLOB_BASE_FEE_UPDATE_FRACTION,
+    )
+
+
+def fake_exponential(factor: int, numerator: int, denominator: int) -> int:
+    """EIP-4844 blob base-fee curve: factor * e**(numerator/denominator)
+    by Taylor expansion, exact integer arithmetic (consensus-critical)."""
+    i = 1
+    output = 0
+    numerator_accum = factor * denominator
+    while numerator_accum > 0:
+        output += numerator_accum
+        numerator_accum = (numerator_accum * numerator) // (denominator * i)
+        i += 1
+    return output // denominator
+
+
+def blob_base_fee(
+    excess_blob_gas: int, fraction: int = BLOB_BASE_FEE_UPDATE_FRACTION
+) -> int:
+    return fake_exponential(MIN_BLOB_BASE_FEE, excess_blob_gas, fraction)
+
+
+def calc_excess_blob_gas(
+    parent_excess: int,
+    parent_blob_gas_used: int,
+    target: int = TARGET_BLOB_GAS_PER_BLOCK,
+) -> int:
+    total = parent_excess + parent_blob_gas_used
+    if total < target:
+        return 0
+    return total - target

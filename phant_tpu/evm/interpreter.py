@@ -20,7 +20,13 @@ from typing import Dict, List, Optional, Set, Tuple
 
 from phant_tpu.crypto.keccak import keccak256
 from phant_tpu.evm import gas as G
-from phant_tpu.evm.message import Environment, EVMError, ExecResult, Message
+from phant_tpu.evm.message import (
+    Environment,
+    EVMError,
+    ExecResult,
+    Message,
+    REVISION_CANCUN,
+)
 from phant_tpu.evm.precompiles import PRECOMPILES, precompile_addresses
 from phant_tpu.types.receipt import Log
 from phant_tpu import rlp
@@ -134,6 +140,10 @@ class Evm:
     def __init__(self, env: Environment):
         self.env = env
         self.state = env.state
+        # optional per-instruction tracer: fn(pc, op, gas, depth, stack_size).
+        # Same hook shape on both backends (native/evm.cc PhantHost.trace),
+        # so a fixture divergence is localized by diffing the two traces.
+        self.tracer = None
 
     # ------------------------------------------------------------------
     # top level (reference: VM.processMessageCall vm.zig:67-124)
@@ -275,8 +285,11 @@ class Evm:
         env = self.env
         code = frame.code
         n = len(code)
+        tracer = self.tracer
         while frame.pc < n:
             op = code[frame.pc]
+            if tracer is not None:
+                tracer(frame.pc, op, frame.gas, frame.msg.depth, len(stack))
             frame.pc += 1
             # ---- push family (most common) ----
             if 0x60 <= op <= 0x7F:
@@ -700,6 +713,31 @@ def _basefee(evm, frame):
     frame.push(evm.env.base_fee)
 
 
+def _require_cancun(evm) -> None:
+    """Cancun opcodes are invalid bytes under earlier revisions — fork
+    dispatch the reference TODO-pins away (src/blockchain/vm.zig:472)."""
+    if evm.env.revision < REVISION_CANCUN:
+        raise EVMError("invalid opcode (pre-Cancun)")
+
+
+@op(0x49)
+def _blobhash(evm, frame):
+    """EIP-4844 BLOBHASH: tx's i-th blob versioned hash, else 0."""
+    _require_cancun(evm)
+    frame.use_gas(G.BLOBHASH_GAS)
+    i = frame.pop()
+    hashes = evm.env.blob_hashes
+    frame.push(int.from_bytes(hashes[i], "big") if i < len(hashes) else 0)
+
+
+@op(0x4A)
+def _blobbasefee(evm, frame):
+    """EIP-7516 BLOBBASEFEE: the block's blob base fee."""
+    _require_cancun(evm)
+    frame.use_gas(G.BLOBBASEFEE_GAS)
+    frame.push(evm.env.blob_base_fee)
+
+
 # ---- 0x50s: stack/memory/storage/flow ----
 
 
@@ -816,6 +854,39 @@ def _gas(evm, frame):
 @op(0x5B, 1)
 def _jumpdest(evm, frame):
     pass
+
+
+@op(0x5C)
+def _tload(evm, frame):
+    """EIP-1153 TLOAD (Cancun): transient storage read, flat warm cost."""
+    _require_cancun(evm)
+    frame.use_gas(G.TLOAD_GAS)
+    slot = frame.pop()
+    frame.push(evm.state.get_transient(frame.address, slot))
+
+
+@op(0x5D)
+def _tstore(evm, frame):
+    """EIP-1153 TSTORE (Cancun): journaled for reverts, cleared per tx."""
+    _require_cancun(evm)
+    if frame.msg.is_static:
+        raise EVMError("static call state change")
+    frame.use_gas(G.TSTORE_GAS)
+    slot, value = frame.pop(), frame.pop()
+    evm.state.set_transient(frame.address, slot, value)
+
+
+@op(0x5E)
+def _mcopy(evm, frame):
+    """EIP-5656 MCOPY (Cancun): memory-to-memory copy, overlap-safe."""
+    _require_cancun(evm)
+    dest, src, size = frame.pop(), frame.pop(), frame.pop()
+    frame.use_gas(3 + G.copy_cost(size))
+    if size:
+        # one expansion covering both ranges (charged on the larger end)
+        frame.expand_memory(max(dest, src), size)
+        data = frame.mread(src, size)
+        frame.mwrite(dest, data)
 
 
 @op(0x5F, 2)

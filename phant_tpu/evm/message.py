@@ -13,6 +13,11 @@ from typing import Callable, Optional, TYPE_CHECKING
 if TYPE_CHECKING:
     from phant_tpu.state.statedb import StateDB
 
+# EVM revisions (the reference hardcodes EVMC_SHANGHAI with a TODO,
+# src/blockchain/vm.zig:472; this framework dispatches per fork)
+REVISION_SHANGHAI = 0
+REVISION_CANCUN = 1
+
 
 @dataclass
 class Environment:
@@ -30,6 +35,10 @@ class Environment:
     base_fee: int = 0
     chain_id: int = 1
     block_hash_fn: Optional[Callable[[int], bytes]] = None  # fork BLOCKHASH
+    revision: int = REVISION_SHANGHAI
+    # EIP-4844 (Cancun): the tx's blob versioned hashes + block blob base fee
+    blob_hashes: tuple = ()
+    blob_base_fee: int = 0
 
     def get_block_hash(self, number: int) -> bytes:
         if self.block_hash_fn is None:

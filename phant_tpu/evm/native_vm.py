@@ -38,6 +38,11 @@ class PhantTxContext(ct.Structure):
         ("gas_price", _B32),
         ("prev_randao", _B32),
         ("base_fee", _B32),
+        # Cancun extensions (must mirror native/evm.cc PhantTxContext)
+        ("revision", ct.c_uint64),
+        ("blob_base_fee", _B32),
+        ("blob_hashes", ct.POINTER(ct.c_uint8)),
+        ("n_blob_hashes", ct.c_uint64),
     ]
 
 
@@ -110,6 +115,22 @@ _CB = {
     "call": ct.CFUNCTYPE(
         None, ct.c_void_p, ct.POINTER(PhantMsg), ct.POINTER(PhantResult)
     ),
+    # EIP-1153 transient storage (Cancun); appended after `call` to keep
+    # the vtable layout a strict prefix of the pre-Cancun one
+    "get_transient": ct.CFUNCTYPE(
+        None, ct.c_void_p, ct.POINTER(ct.c_uint8), ct.POINTER(ct.c_uint8),
+        ct.POINTER(ct.c_uint8),
+    ),
+    "set_transient": ct.CFUNCTYPE(
+        None, ct.c_void_p, ct.POINTER(ct.c_uint8), ct.POINTER(ct.c_uint8),
+        ct.POINTER(ct.c_uint8),
+    ),
+    # optional per-instruction tracer (installed only when Evm.tracer is
+    # set; NULL otherwise so the C loop pays one predictable branch)
+    "trace": ct.CFUNCTYPE(
+        None, ct.c_void_p, ct.c_uint64, ct.c_int32, ct.c_int64, ct.c_int32,
+        ct.c_int32,
+    ),
 }
 
 
@@ -172,6 +193,20 @@ class NativeSession:
         ct.memmove(self.txc.gas_price, env.gas_price.to_bytes(32, "big"), 32)
         ct.memmove(self.txc.prev_randao, env.prev_randao, 32)
         ct.memmove(self.txc.base_fee, env.base_fee.to_bytes(32, "big"), 32)
+        self.txc.revision = env.revision
+        ct.memmove(
+            self.txc.blob_base_fee, env.blob_base_fee.to_bytes(32, "big"), 32
+        )
+        if env.blob_hashes:
+            raw = b"".join(env.blob_hashes)
+            self._blob_buf = ct.create_string_buffer(raw, len(raw))
+            self.txc.blob_hashes = ct.cast(
+                self._blob_buf, ct.POINTER(ct.c_uint8)
+            )
+            self.txc.n_blob_hashes = len(env.blob_hashes)
+        else:
+            self.txc.blob_hashes = None
+            self.txc.n_blob_hashes = 0
 
         # single-slot holder for the child-output buffer crossing the C
         # boundary: the C++ caller copies it immediately after host->call
@@ -185,6 +220,10 @@ class NativeSession:
         # return None regardless
         int_cbs = {"access_account", "access_storage", "get_code_size", "is_empty"}
         for name in _CB:
+            if name == "trace" and getattr(evm, "tracer", None) is None:
+                # leave the vtable slot NULL: the C loop skips tracing
+                setattr(self.host, name, _CB[name]())
+                continue
             raw = getattr(self, "_cb_" + name)
             guarded = self._guard(raw, 0 if name in int_cbs else None)
             cb = _CB[name](guarded)
@@ -258,6 +297,17 @@ class NativeSession:
 
     def _cb_add_refund(self, _ctx, delta) -> None:
         self.state.add_refund(delta)
+
+    def _cb_get_transient(self, _ctx, addr, key, out) -> None:
+        _write32(out, self.state.get_transient(_bytes20(addr), _bytes32_int(key)))
+
+    def _cb_set_transient(self, _ctx, addr, key, val) -> None:
+        self.state.set_transient(
+            _bytes20(addr), _bytes32_int(key), _bytes32_int(val)
+        )
+
+    def _cb_trace(self, _ctx, pc, op, gas, depth, stack_size) -> None:
+        self.evm.tracer(pc, op, gas, depth, stack_size)
 
     def _cb_selfdestruct(self, _ctx, addr, beneficiary) -> None:
         # state effects of SELFDESTRUCT (interpreter.py _selfdestruct)

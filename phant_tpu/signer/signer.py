@@ -16,6 +16,7 @@ from phant_tpu.crypto.keccak import keccak256
 from phant_tpu.crypto.secp256k1 import SignatureError
 from phant_tpu.types.transaction import (
     AccessListTx,
+    BlobTx,
     FeeMarketTx,
     LegacyTx,
     Transaction,
@@ -72,6 +73,22 @@ def signing_hash(tx: Transaction, chain_id: int) -> bytes:
             _encode_access_list(tx.access_list),
         ]
         return keccak256(b"\x02" + rlp.encode(payload))
+    if isinstance(tx, BlobTx):
+        # EIP-4844: 0x03 ‖ rlp([..., max_fee_per_blob_gas, blob_hashes])
+        payload = [
+            rlp.encode_uint(tx.chain_id_val),
+            rlp.encode_uint(tx.nonce),
+            rlp.encode_uint(tx.max_priority_fee_per_gas),
+            rlp.encode_uint(tx.max_fee_per_gas),
+            rlp.encode_uint(tx.gas_limit),
+            tx.to if tx.to is not None else b"",
+            rlp.encode_uint(tx.value),
+            tx.data,
+            _encode_access_list(tx.access_list),
+            rlp.encode_uint(tx.max_fee_per_blob_gas),
+            [h for h in tx.blob_versioned_hashes],
+        ]
+        return keccak256(b"\x03" + rlp.encode(payload))
     raise TypeError(f"unknown tx type {type(tx).__name__}")
 
 

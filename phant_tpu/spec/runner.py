@@ -37,10 +37,25 @@ def run_fixture(fixture: Fixture) -> None:
     state = StateDB({addr: acct.copy() for addr, acct in fixture.pre.items()})
     genesis = Block.decode(fixture.genesis_rlp)
 
+    # fork selection from the fixture's network name (the reference
+    # hardcodes a Prague fork instance in its one engine-api test and runs
+    # fixtures on Frontier BLOCKHASH semantics, spec_tests.zig:82-100)
+    fork = None
+    net = fixture.network.lower()
+    if "cancun" in net:
+        from phant_tpu.blockchain.fork import CancunFork
+
+        fork = CancunFork(state)  # pre-deploys beacon-roots if absent
+    elif "prague" in net or "osaka" in net:
+        from phant_tpu.blockchain.fork import PragueFork
+
+        fork = PragueFork(state)
+
     chain = Blockchain(
         chain_id=1,  # fixtures run on chain id 1 (SpecTest network)
         state=state,
         parent_header=genesis.header,
+        fork=fork,
     )
 
     last_valid_hash = genesis.header.hash()
@@ -149,10 +164,12 @@ def run_fixture_stateless(fixture: Fixture) -> None:
     from phant_tpu.blockchain.fork import FrontierFork
     from phant_tpu.stateless import StatelessError, execute_stateless
 
-    if any(n in fixture.network.lower() for n in ("prague", "osaka")):
-        # Prague-family blocks write EIP-2935 history slots into the post
-        # root; the runner would need a chainspec-derived fork_for config
-        # (as the engine handler uses) — fail loudly rather than mis-root
+    if any(n in fixture.network.lower() for n in ("cancun", "prague", "osaka")):
+        # Cancun/Prague-family blocks write fork system slots (EIP-4788
+        # beacon roots / EIP-2935 history) into the post root; the stateless
+        # re-run would need the fork constructed over the witness state —
+        # fail loudly rather than mis-root (the STATEFUL runner covers
+        # these networks with the right fork)
         raise FixtureFailure(
             f"{fixture.name}: stateless runner has no fork config for "
             f"network {fixture.network!r}"

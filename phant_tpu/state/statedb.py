@@ -49,6 +49,9 @@ class StateDB:
         self.created: Set[Address] = set()
         self.logs: List[Log] = []
         self.refund: int = 0
+        # EIP-1153 transient storage (Cancun): per-transaction, journaled
+        # for call-scope reverts, discarded wholesale at tx end
+        self.transient: Dict[Tuple[Address, int], int] = {}
 
     # ------------------------------------------------------------------
     # tx lifecycle
@@ -79,6 +82,7 @@ class StateDB:
         self.created = set()
         self.logs = []
         self.refund = 0
+        self.transient = {}  # EIP-1153: cleared at transaction boundaries
 
     # ------------------------------------------------------------------
     # snapshots (journal marks)
@@ -148,6 +152,12 @@ class StateDB:
             elif tag == "refund":
                 (old,) = payload
                 self.refund = old
+            elif tag == "transient":
+                key, old = payload
+                if old == 0:
+                    self.transient.pop(key, None)
+                else:
+                    self.transient[key] = old
             else:  # pragma: no cover
                 raise AssertionError(f"unknown journal tag {tag}")
 
@@ -264,6 +274,22 @@ class StateDB:
         if key in self._tx_original:
             return self._tx_original[key]
         return self.get_storage(addr, slot)
+
+    # ------------------------------------------------------------------
+    # EIP-1153 transient storage (Cancun; no reference analog — the
+    # reference EVM is pinned to Shanghai, src/blockchain/vm.zig:472)
+    # ------------------------------------------------------------------
+
+    def get_transient(self, addr: Address, slot: int) -> int:
+        return self.transient.get((addr, slot), 0)
+
+    def set_transient(self, addr: Address, slot: int, value: int) -> None:
+        key = (addr, slot)
+        self._journal.append(("transient", key, self.transient.get(key, 0)))
+        if value == 0:
+            self.transient.pop(key, None)
+        else:
+            self.transient[key] = value
 
     # ------------------------------------------------------------------
     # EIP-2929 warm sets (journaled: reverted scopes re-cool their additions)

@@ -1,9 +1,11 @@
-"""Ethereum transaction types: legacy, EIP-2930 access-list, EIP-1559 fee-market.
+"""Ethereum transaction types: legacy, EIP-2930 access-list, EIP-1559
+fee-market, EIP-4844 blob (Cancun).
 
 Equivalent surface to the reference's tagged union (reference:
-src/types/transaction.zig:10-273): EIP-2718 typed envelope decode/encode,
-per-type keccak tx hash, and uniform getters. Implemented as dataclasses with
-a small dispatch table instead of a tagged union.
+src/types/transaction.zig:10-273) plus the type-3 blob transaction the
+reference lacks (its chainspec stops at Shanghai): EIP-2718 typed envelope
+decode/encode, per-type keccak tx hash, and uniform getters. Implemented
+as dataclasses with a small dispatch table instead of a tagged union.
 """
 
 from __future__ import annotations
@@ -19,6 +21,13 @@ AccessListEntry = Tuple[bytes, Tuple[bytes, ...]]  # (address20, (storage_key32,
 TX_TYPE_LEGACY = 0x00
 TX_TYPE_ACCESS_LIST = 0x01
 TX_TYPE_FEE_MARKET = 0x02
+TX_TYPE_BLOB = 0x03
+
+# EIP-4844 blob constants (consensus-critical); GAS_PER_BLOB's single
+# source of truth is the gas schedule (phant_tpu/evm/gas.py)
+from phant_tpu.evm.gas import GAS_PER_BLOB  # noqa: E402
+
+VERSIONED_HASH_VERSION_KZG = 0x01
 
 
 def _encode_access_list(access_list: Sequence[AccessListEntry]) -> list:
@@ -219,7 +228,87 @@ class FeeMarketTx:
         )
 
 
-Transaction = Union[LegacyTx, AccessListTx, FeeMarketTx]
+@dataclass(frozen=True)
+class BlobTx:
+    """EIP-4844 typed tx 0x03 (Cancun; beyond the reference's Shanghai
+    ceiling, src/types/transaction.zig stops at type 0x02). This is the
+    *payload* form that appears in blocks and Engine API payloads — the
+    network wrapper (blobs + KZG commitments + proofs) never enters the
+    execution layer."""
+
+    chain_id_val: int
+    nonce: int
+    max_priority_fee_per_gas: int
+    max_fee_per_gas: int
+    gas_limit: int
+    to: Optional[bytes]  # MUST be a 20-byte address (no blob creates)
+    value: int
+    data: bytes
+    access_list: Tuple[AccessListEntry, ...]
+    max_fee_per_blob_gas: int
+    blob_versioned_hashes: Tuple[bytes, ...]
+    y_parity: int
+    r: int
+    s: int
+
+    tx_type: int = field(default=TX_TYPE_BLOB, init=False, repr=False)
+
+    def fields(self) -> list:
+        return [
+            rlp.encode_uint(self.chain_id_val),
+            rlp.encode_uint(self.nonce),
+            rlp.encode_uint(self.max_priority_fee_per_gas),
+            rlp.encode_uint(self.max_fee_per_gas),
+            rlp.encode_uint(self.gas_limit),
+            self.to if self.to is not None else b"",
+            rlp.encode_uint(self.value),
+            self.data,
+            _encode_access_list(self.access_list),
+            rlp.encode_uint(self.max_fee_per_blob_gas),
+            [h for h in self.blob_versioned_hashes],
+            rlp.encode_uint(self.y_parity),
+            rlp.encode_uint(self.r),
+            rlp.encode_uint(self.s),
+        ]
+
+    def encode(self) -> bytes:
+        return bytes([TX_TYPE_BLOB]) + rlp.encode(self.fields())
+
+    def hash(self) -> bytes:
+        return keccak256(self.encode())
+
+    def chain_id(self) -> Optional[int]:
+        return self.chain_id_val
+
+    def blob_gas(self) -> int:
+        return GAS_PER_BLOB * len(self.blob_versioned_hashes)
+
+    @classmethod
+    def from_rlp_list(cls, items: list) -> "BlobTx":
+        if len(items) != 14:
+            raise rlp.DecodeError(f"4844 tx wants 14 fields, got {len(items)}")
+        to = bytes(items[5])
+        if len(to) != 20:
+            raise rlp.DecodeError("blob tx `to` must be a 20-byte address")
+        return cls(
+            chain_id_val=rlp.decode_uint(items[0]),
+            nonce=rlp.decode_uint(items[1]),
+            max_priority_fee_per_gas=rlp.decode_uint(items[2]),
+            max_fee_per_gas=rlp.decode_uint(items[3]),
+            gas_limit=rlp.decode_uint(items[4]),
+            to=to,
+            value=rlp.decode_uint(items[6]),
+            data=bytes(items[7]),
+            access_list=_decode_access_list(items[8]),
+            max_fee_per_blob_gas=rlp.decode_uint(items[9]),
+            blob_versioned_hashes=tuple(bytes(h) for h in items[10]),
+            y_parity=rlp.decode_uint(items[11]),
+            r=rlp.decode_uint(items[12]),
+            s=rlp.decode_uint(items[13]),
+        )
+
+
+Transaction = Union[LegacyTx, AccessListTx, FeeMarketTx, BlobTx]
 
 
 def decode_tx(data: bytes) -> Transaction:
@@ -242,6 +331,11 @@ def decode_tx(data: bytes) -> Transaction:
         if not isinstance(items, list):
             raise rlp.DecodeError("typed tx payload must be an RLP list")
         return FeeMarketTx.from_rlp_list(items)
+    if first == TX_TYPE_BLOB:
+        items = rlp.decode(data[1:])
+        if not isinstance(items, list):
+            raise rlp.DecodeError("typed tx payload must be an RLP list")
+        return BlobTx.from_rlp_list(items)
     raise rlp.DecodeError(f"unsupported tx type 0x{first:02x}")
 
 
@@ -268,16 +362,20 @@ def encode_tx_for_block(tx: Transaction):
 def effective_gas_price(tx: Transaction, base_fee: int) -> int:
     """EIP-1559 effective price; legacy/2930 are flat gas_price
     (reference: src/blockchain/blockchain.zig:276-287)."""
-    if isinstance(tx, FeeMarketTx):
+    if isinstance(tx, (FeeMarketTx, BlobTx)):
         priority = min(tx.max_priority_fee_per_gas, tx.max_fee_per_gas - base_fee)
         return priority + base_fee
     return tx.gas_price
 
 
 def max_fee_per_gas(tx: Transaction) -> int:
-    if isinstance(tx, FeeMarketTx):
+    if isinstance(tx, (FeeMarketTx, BlobTx)):
         return tx.max_fee_per_gas
     return tx.gas_price
+
+
+def blob_gas_of(tx: Transaction) -> int:
+    return tx.blob_gas() if isinstance(tx, BlobTx) else 0
 
 
 def access_list_of(tx: Transaction) -> Tuple[AccessListEntry, ...]:

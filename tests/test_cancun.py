@@ -1,0 +1,487 @@
+"""Cancun: EIP-1153 TSTORE/TLOAD, EIP-5656 MCOPY, EIP-4844 blob txs +
+BLOBHASH + blob-gas header rules, EIP-7516 BLOBBASEFEE, EIP-4788 beacon
+roots — differential across the python and native EVM backends.
+
+The reference client stops at Shanghai (EVMC_SHANGHAI pinned with a TODO,
+reference: src/blockchain/vm.zig:472; chainspec has no cancunTime); this
+framework implements the fork end to end, so these tests have no reference
+analog — semantics are pinned against the EIPs' own rules.
+"""
+
+from dataclasses import replace
+
+import pytest
+
+from phant_tpu.crypto.keccak import keccak256
+from phant_tpu.evm import gas as G
+from phant_tpu.evm.interpreter import Evm
+from phant_tpu.evm.message import (
+    Environment,
+    Message,
+    REVISION_CANCUN,
+    REVISION_SHANGHAI,
+)
+from phant_tpu.state.statedb import StateDB
+from phant_tpu.types.account import Account
+
+SENDER = b"\x10" * 20
+OTHER = b"\x20" * 20
+
+
+def _run(code, revision=REVISION_CANCUN, data=b"", gas=200_000,
+         blob_hashes=(), blob_base_fee=0, state=None, static=False):
+    state = state or StateDB(
+        {SENDER: Account(balance=10**18), OTHER: Account(code=code)}
+    )
+    if OTHER not in state.accounts:
+        state.accounts[OTHER] = Account(code=code)
+    state.start_tx()
+    env = Environment(
+        state=state, origin=SENDER, coinbase=b"\xc0" * 20, block_number=1,
+        timestamp=1000, base_fee=7, gas_price=10, revision=revision,
+        blob_hashes=blob_hashes, blob_base_fee=blob_base_fee,
+    )
+    evm = Evm(env)
+    res = evm.execute_message(
+        Message(caller=SENDER, target=OTHER, value=0, data=data, gas=gas,
+                is_static=static)
+    )
+    return res, state
+
+
+# ---------------------------------------------------------------------------
+# EIP-1153 transient storage
+# ---------------------------------------------------------------------------
+
+
+def test_tstore_tload_roundtrip(evm_backend):
+    # TSTORE(5, 0x2a); TLOAD(5) -> return
+    code = bytes.fromhex("602a60055d60055c60005260206000f3")
+    res, state = _run(code)
+    assert res.success, res.error
+    assert int.from_bytes(res.output, "big") == 0x2A
+    # transient storage never touches persistent storage
+    assert state.get_storage(OTHER, 5) == 0
+
+
+def test_transient_cleared_between_txs(evm_backend):
+    store = bytes.fromhex("602a60055d00")  # TSTORE(5, 42); STOP
+    load = bytes.fromhex("60055c60005260206000f3")  # return TLOAD(5)
+    res, state = _run(store)
+    assert res.success
+    assert state.get_transient(OTHER, 5) == 42
+    state.start_tx()  # next transaction: transient state is discarded
+    state.accounts[OTHER].code = load
+    env = Environment(state=state, origin=SENDER, revision=REVISION_CANCUN)
+    res2 = Evm(env).execute_message(
+        Message(caller=SENDER, target=OTHER, value=0, data=b"", gas=100_000)
+    )
+    assert res2.success
+    assert int.from_bytes(res2.output, "big") == 0
+
+
+def test_transient_reverted_with_call_scope(evm_backend):
+    """A reverting child's TSTOREs must unwind (journaled like storage)."""
+    child = b"\x30" * 20
+    # child: TSTORE(1, 7) then REVERT
+    child_code = bytes.fromhex("600760015d60006000fd")
+    # parent: CALL child; return TLOAD(1)
+    parent_code = bytes.fromhex(
+        "60006000600060006000"  # ret/in args + value 0
+        + "73" + child.hex()  # PUSH20 child
+        + "61ffff"  # PUSH2 gas
+        + "f1"  # CALL
+        + "50"  # POP status
+        + "60015c60005260206000f3"  # return TLOAD(1)
+    )
+    state = StateDB(
+        {
+            SENDER: Account(balance=10**18),
+            OTHER: Account(code=parent_code),
+            child: Account(code=child_code),
+        }
+    )
+    res, state = _run(parent_code, state=state)
+    assert res.success, res.error
+    assert int.from_bytes(res.output, "big") == 0  # child's TSTORE unwound
+
+
+def test_tstore_static_context_fails(evm_backend):
+    code = bytes.fromhex("602a60055d00")
+    res, _ = _run(code, static=True)
+    assert not res.success
+
+
+def test_tload_pre_cancun_invalid(evm_backend):
+    code = bytes.fromhex("60055c00")
+    res, _ = _run(code, revision=REVISION_SHANGHAI)
+    assert not res.success
+    assert res.gas_left == 0  # invalid opcode: exceptional halt
+
+
+# ---------------------------------------------------------------------------
+# EIP-5656 MCOPY
+# ---------------------------------------------------------------------------
+
+
+def test_mcopy_basic(evm_backend):
+    # MSTORE(0, x); MCOPY(0x20, 0, 0x20); return mem[0x20:0x40]
+    code = bytes.fromhex(
+        "7f" + "11" * 32  # PUSH32 x
+        + "600052"  # MSTORE(0)
+        + "602060006020"  # size=0x20 src=0 dest=0x20 (pushed size,src? order)
+        + "5e"  # MCOPY pops dest, src, size
+        + "60206020f3"  # RETURN mem[0x20:0x40]
+    )
+    # stack for MCOPY: push size FIRST so pops give dest, src, size
+    # pushed: 0x20 (size), 0x00 (src), 0x20 (dest)
+    res, _ = _run(code)
+    assert res.success, res.error
+    assert res.output == b"\x11" * 32
+
+
+def test_mcopy_overlap_forward(evm_backend):
+    """Overlapping ranges must behave like memmove, not memcpy."""
+    # mem[0:32] = pattern; MCOPY(1, 0, 32); return mem[0:64]
+    code = bytes.fromhex(
+        "7f" + bytes(range(1, 33)).hex()
+        + "600052"
+        + "602060006001"  # size=32 src=0 dest=1
+        + "5e"
+        + "60406000f3"
+    )
+    res, _ = _run(code)
+    assert res.success, res.error
+    want = bytearray(64)
+    want[0:32] = bytes(range(1, 33))
+    mem = bytearray(want)
+    mem[1:33] = bytes(want[0:32])
+    assert res.output == bytes(mem)
+
+
+def test_mcopy_pre_cancun_invalid(evm_backend):
+    code = bytes.fromhex("6020600060015e00")
+    res, _ = _run(code, revision=REVISION_SHANGHAI)
+    assert not res.success
+
+
+# ---------------------------------------------------------------------------
+# EIP-4844 BLOBHASH / EIP-7516 BLOBBASEFEE
+# ---------------------------------------------------------------------------
+
+
+def test_blobhash_indexing(evm_backend):
+    h0 = bytes([1]) + keccak256(b"blob0")[1:]
+    h1 = bytes([1]) + keccak256(b"blob1")[1:]
+    # return BLOBHASH(calldataload(0))
+    code = bytes.fromhex("6000354960005260206000f3")
+    for idx, want in ((0, h0), (1, h1), (2, b"\x00" * 32)):
+        res, _ = _run(
+            code, data=idx.to_bytes(32, "big"), blob_hashes=(h0, h1)
+        )
+        assert res.success, res.error
+        assert res.output == want
+
+
+def test_blobbasefee(evm_backend):
+    code = bytes.fromhex("4a60005260206000f3")
+    res, _ = _run(code, blob_base_fee=123456)
+    assert res.success, res.error
+    assert int.from_bytes(res.output, "big") == 123456
+
+
+def test_blob_opcodes_pre_cancun_invalid(evm_backend):
+    for code in (bytes.fromhex("60004900"), bytes.fromhex("4a00")):
+        res, _ = _run(code, revision=REVISION_SHANGHAI)
+        assert not res.success
+
+
+# ---------------------------------------------------------------------------
+# blob base-fee curve (consensus-critical integer math)
+# ---------------------------------------------------------------------------
+
+
+def test_blob_base_fee_curve():
+    assert G.blob_base_fee(0) == 1
+    assert G.blob_base_fee(G.TARGET_BLOB_GAS_PER_BLOCK) == 1
+    # e^1 = 2.718...: fake_exponential(1, F, F) floors to 2
+    assert G.fake_exponential(1, G.BLOB_BASE_FEE_UPDATE_FRACTION,
+                              G.BLOB_BASE_FEE_UPDATE_FRACTION) == 2
+    # monotone non-decreasing in excess
+    prev = 0
+    for excess in range(0, 40 * G.GAS_PER_BLOB, 4 * G.GAS_PER_BLOB):
+        fee = G.blob_base_fee(excess)
+        assert fee >= prev
+        prev = fee
+    assert prev > 1
+
+
+def test_calc_excess_blob_gas():
+    T = G.TARGET_BLOB_GAS_PER_BLOCK
+    assert G.calc_excess_blob_gas(0, 0) == 0
+    assert G.calc_excess_blob_gas(0, T) == 0
+    assert G.calc_excess_blob_gas(0, T + G.GAS_PER_BLOB) == G.GAS_PER_BLOB
+    assert G.calc_excess_blob_gas(T, T) == T
+
+
+# ---------------------------------------------------------------------------
+# type-3 transaction: codec + signing
+# ---------------------------------------------------------------------------
+
+
+def _blob_tx(**kw):
+    from phant_tpu.types.transaction import BlobTx
+
+    defaults = dict(
+        chain_id_val=1, nonce=0, max_priority_fee_per_gas=1,
+        max_fee_per_gas=10**9, gas_limit=100_000, to=b"\x99" * 20, value=5,
+        data=b"\xab\xcd", access_list=((b"\x77" * 20, (b"\x01" * 32,)),),
+        max_fee_per_blob_gas=100,
+        blob_versioned_hashes=(bytes([1]) + b"\x22" * 31,),
+        y_parity=0, r=0, s=0,
+    )
+    defaults.update(kw)
+    return BlobTx(**defaults)
+
+
+def test_blob_tx_roundtrip():
+    from phant_tpu.types.transaction import decode_tx
+
+    tx = _blob_tx(r=123, s=456, y_parity=1)
+    raw = tx.encode()
+    assert raw[0] == 0x03
+    assert decode_tx(raw) == tx
+    assert tx.blob_gas() == G.GAS_PER_BLOB
+
+
+def test_blob_tx_sign_and_recover():
+    from phant_tpu.signer.signer import TxSigner
+
+    signer = TxSigner(1)
+    key = 0xA11CE
+    signed = signer.sign(_blob_tx(), key)
+    from phant_tpu.crypto import secp256k1 as secp
+    from phant_tpu.signer.signer import address_from_pubkey
+
+    assert signer.get_sender(signed) == address_from_pubkey(secp.pubkey_of(key))
+    # signature covers max_fee_per_blob_gas: tampering breaks recovery
+    tampered = replace(signed, max_fee_per_blob_gas=101)
+    assert signer.get_sender(tampered) != signer.get_sender(signed)
+
+
+def test_blob_tx_to_none_rejected():
+    from phant_tpu import rlp
+    from phant_tpu.types.transaction import decode_tx
+
+    tx = _blob_tx()
+    items = tx.fields()
+    items[5] = b""  # nil `to`
+    with pytest.raises(rlp.DecodeError):
+        decode_tx(bytes([0x03]) + rlp.encode(items))
+
+
+# ---------------------------------------------------------------------------
+# block-level: header rules, blob fee burn, beacon roots (both backends)
+# ---------------------------------------------------------------------------
+
+
+def _cancun_chain(evm_backend_name=None):
+    """A tiny executed Cancun chain: one blob tx calling a contract that
+    stores BLOBHASH(0) and BLOBBASEFEE, so post-state pins the opcodes'
+    values end to end."""
+    from dataclasses import replace as drep
+
+    from phant_tpu.blockchain.chain import Blockchain, calculate_base_fee
+    from phant_tpu.crypto import secp256k1 as secp
+    from phant_tpu.mpt.mpt import EMPTY_TRIE_ROOT, ordered_trie_root
+    from phant_tpu.signer.signer import TxSigner, address_from_pubkey
+    from phant_tpu.types.block import Block, BlockHeader
+    from phant_tpu.types.receipt import logs_bloom
+
+    key = 0xB0B
+    sender = address_from_pubkey(secp.pubkey_of(key))
+    contract = b"\xcc" * 20
+    # store BLOBHASH(0) at slot0, BLOBBASEFEE at slot1
+    code = bytes.fromhex("60004960005549600155") + bytes.fromhex(
+        "4a600155"
+    )
+    # simpler: BLOBHASH(0)->slot0; BLOBBASEFEE->slot1
+    code = bytes.fromhex("600049600055" + "4a600155" + "00")
+    accounts = {
+        sender: Account(balance=10**24),
+        contract: Account(code=code),
+    }
+    genesis = BlockHeader(
+        block_number=0, gas_limit=30_000_000, gas_used=0,
+        timestamp=1_700_000_000, base_fee_per_gas=10**9,
+        withdrawals_root=EMPTY_TRIE_ROOT, blob_gas_used=0, excess_blob_gas=0,
+    )
+    signer = TxSigner(1)
+    blob_hash = bytes([1]) + b"\x42" * 31
+    tx = signer.sign(
+        _blob_tx(
+            to=contract, data=b"", value=0, access_list=(),
+            blob_versioned_hashes=(blob_hash,), max_fee_per_blob_gas=10,
+            max_priority_fee_per_gas=1,
+        ),
+        key,
+    )
+    state = StateDB({a: acct.copy() for a, acct in accounts.items()})
+    chain = Blockchain(1, state, genesis, verify_state_root=False)
+    base_fee = calculate_base_fee(
+        genesis.gas_limit, genesis.gas_used, genesis.base_fee_per_gas
+    )
+    draft = BlockHeader(
+        parent_hash=genesis.hash(), block_number=1,
+        gas_limit=30_000_000, gas_used=0, timestamp=genesis.timestamp + 12,
+        base_fee_per_gas=base_fee,
+        transactions_root=ordered_trie_root([tx.encode()]),
+        receipts_root=EMPTY_TRIE_ROOT, withdrawals_root=EMPTY_TRIE_ROOT,
+        logs_bloom=logs_bloom([]),
+        blob_gas_used=G.GAS_PER_BLOB, excess_blob_gas=0,
+        parent_beacon_block_root=b"\x5b" * 32,
+    )
+    result = chain.apply_body(Block(header=draft, transactions=(tx,), withdrawals=()))
+    header = drep(
+        draft,
+        gas_used=result.gas_used,
+        receipts_root=ordered_trie_root([r.encode() for r in result.receipts]),
+        logs_bloom=result.logs_bloom,
+    )
+    block = Block(header=header, transactions=(tx,), withdrawals=())
+    return accounts, genesis, block, sender, contract, blob_hash
+
+
+def test_cancun_block_end_to_end(evm_backend):
+    from phant_tpu.blockchain.chain import Blockchain
+    from phant_tpu.blockchain.fork import (
+        BEACON_ROOTS_ADDRESS,
+        BEACON_ROOTS_BUFFER,
+        CancunFork,
+    )
+
+    accounts, genesis, block, sender, contract, blob_hash = _cancun_chain()
+    state = StateDB({a: acct.copy() for a, acct in accounts.items()})
+    chain = Blockchain(
+        1, state, genesis, fork=CancunFork(state), verify_state_root=False
+    )
+    chain.run_block(block)
+
+    # the contract saw the tx's blob hash and the block's blob base fee
+    assert state.get_storage(contract, 0) == int.from_bytes(blob_hash, "big")
+    assert state.get_storage(contract, 1) == G.blob_base_fee(0)
+    # blob fee burned: sender paid blob_gas * blob_base_fee(0) = 131072 * 1
+    # on top of execution gas (checked via exact balance accounting)
+    receipt_gas = block.header.gas_used
+    base_fee = block.header.base_fee_per_gas
+    tx = block.transactions[0]
+    priority = min(tx.max_priority_fee_per_gas, tx.max_fee_per_gas - base_fee)
+    spent = receipt_gas * (base_fee + priority) + G.GAS_PER_BLOB * 1
+    assert state.get_balance(sender) == 10**24 - spent
+    # EIP-4788: beacon root recorded in the system contract's ring
+    ts = block.header.timestamp
+    slot = ts % BEACON_ROOTS_BUFFER
+    assert state.get_storage(BEACON_ROOTS_ADDRESS, slot) == ts
+    assert state.get_storage(
+        BEACON_ROOTS_ADDRESS, slot + BEACON_ROOTS_BUFFER
+    ) == int.from_bytes(b"\x5b" * 32, "big")
+
+
+def test_beacon_roots_contract_get_path(evm_backend):
+    """CALL the deployed EIP-4788 bytecode with a 32-byte timestamp: it must
+    return the root the block-start system update stored."""
+    from phant_tpu.blockchain.chain import Blockchain
+    from phant_tpu.blockchain.fork import BEACON_ROOTS_ADDRESS, CancunFork
+
+    accounts, genesis, block, _sender, _contract, _bh = _cancun_chain()
+    state = StateDB({a: acct.copy() for a, acct in accounts.items()})
+    chain = Blockchain(
+        1, state, genesis, fork=CancunFork(state), verify_state_root=False
+    )
+    chain.run_block(block)
+    state.start_tx()
+    env = Environment(
+        state=state, origin=SENDER, timestamp=block.header.timestamp + 12,
+        revision=REVISION_CANCUN,
+    )
+    res = Evm(env).execute_message(
+        Message(
+            caller=SENDER, target=BEACON_ROOTS_ADDRESS, value=0,
+            data=block.header.timestamp.to_bytes(32, "big"), gas=100_000,
+        )
+    )
+    assert res.success, res.error
+    assert res.output == b"\x5b" * 32
+
+
+def test_blob_gas_used_mismatch_rejected(evm_backend):
+    from phant_tpu.blockchain.chain import BlockError, Blockchain
+    from phant_tpu.blockchain.fork import CancunFork
+    from phant_tpu.types.block import Block
+
+    accounts, genesis, block, *_ = _cancun_chain()
+    bad_header = replace(block.header, blob_gas_used=0)
+    bad = Block(header=bad_header, transactions=block.transactions, withdrawals=())
+    state = StateDB({a: acct.copy() for a, acct in accounts.items()})
+    chain = Blockchain(
+        1, state, genesis, fork=CancunFork(state), verify_state_root=False
+    )
+    with pytest.raises(BlockError):
+        chain.run_block(bad)
+
+
+def test_excess_blob_gas_recurrence_enforced():
+    from phant_tpu.blockchain.chain import BlockError, Blockchain
+    from phant_tpu.types.block import Block
+
+    accounts, genesis, block, *_ = _cancun_chain()
+    bad_header = replace(block.header, excess_blob_gas=G.GAS_PER_BLOB)
+    bad = Block(header=bad_header, transactions=block.transactions, withdrawals=())
+    state = StateDB({a: acct.copy() for a, acct in accounts.items()})
+    chain = Blockchain(1, state, genesis, verify_state_root=False)
+    with pytest.raises(BlockError):
+        chain.run_block(bad)
+
+
+def test_max_fee_per_blob_gas_below_base_rejected():
+    from phant_tpu.blockchain.chain import BlockError, Blockchain
+    from phant_tpu.signer.signer import TxSigner
+    from phant_tpu.types.block import Block
+
+    accounts, genesis, block, *_ = _cancun_chain()
+    signer = TxSigner(1)
+    tx = block.transactions[0]
+    bad_tx = signer.sign(replace(tx, max_fee_per_blob_gas=0), 0xB0B)
+    bad = Block(
+        header=replace(
+            block.header,
+        ),
+        transactions=(bad_tx,),
+        withdrawals=(),
+    )
+    state = StateDB({a: acct.copy() for a, acct in accounts.items()})
+    chain = Blockchain(1, state, genesis, verify_state_root=False)
+    with pytest.raises(BlockError):
+        chain.run_block(bad)
+
+
+def test_blob_tx_rejected_pre_cancun():
+    """A blob tx in a Shanghai-shaped block (no blob-gas fields) fails."""
+    from phant_tpu.blockchain.chain import BlockError, Blockchain
+    from phant_tpu.mpt.mpt import EMPTY_TRIE_ROOT
+    from phant_tpu.types.block import Block, BlockHeader
+
+    accounts, genesis, block, *_ = _cancun_chain()
+    pre_genesis = replace(genesis, blob_gas_used=None, excess_blob_gas=None)
+    header = replace(
+        block.header,
+        parent_hash=pre_genesis.hash(),
+        blob_gas_used=None,
+        excess_blob_gas=None,
+        parent_beacon_block_root=None,
+    )
+    bad = Block(header=header, transactions=block.transactions, withdrawals=())
+    state = StateDB({a: acct.copy() for a, acct in accounts.items()})
+    chain = Blockchain(1, state, pre_genesis, verify_state_root=False)
+    with pytest.raises(BlockError):
+        chain.run_block(bad)

@@ -200,13 +200,24 @@ def test_new_payload_v2_invalid_rolls_back_state():
 
 
 def test_fork_for_config():
-    from phant_tpu.blockchain.fork import FrontierFork, PragueFork, fork_for
+    from phant_tpu.blockchain.fork import (
+        CancunFork,
+        FrontierFork,
+        PragueFork,
+        fork_for,
+    )
 
     cfg = ChainConfig.from_chain_id(ChainId.Mainnet)
     state = StateDB()
     assert isinstance(fork_for(cfg, state, 0, 0), FrontierFork)
     assert isinstance(fork_for(cfg, state, 0, cfg.shanghaiTime), FrontierFork)
-    assert isinstance(fork_for(cfg, state, 0, cfg.pragueTime), PragueFork)
+    assert isinstance(fork_for(cfg, state, 0, cfg.cancunTime), CancunFork)
+    # the shipped chainspec only advertises executable forks (no
+    # pragueTime until type-4 txs land); a custom spec still dispatches
+    cfg2 = ChainConfig.from_chain_id(ChainId.Mainnet)
+    cfg2.pragueTime = cfg.cancunTime + 1
+    assert isinstance(fork_for(cfg2, state, 0, cfg2.pragueTime), PragueFork)
+    assert cfg.pragueTime is None
 
 
 def test_crypto_backend_dispatch():
@@ -323,3 +334,39 @@ def test_http_server_roundtrip():
         assert json.loads(exc_info.value.read())["error"]["code"] == -32600
     finally:
         server.shutdown()
+
+
+def test_newpayload_v3_cancun_roundtrip():
+    """engine_newPayloadV3: the side-channel parentBeaconBlockRoot must fold
+    into the header (it is part of blockHash), the expected blob-hash list
+    must be checked, and a valid Cancun payload applies."""
+    from dataclasses import replace
+
+    chain = _fresh_chain()
+    params = _valid_payload_json()
+    params["blobGasUsed"] = "0x0"
+    params["excessBlobGas"] = "0x0"
+    beacon_root = b"\x5b" * 32
+    header = replace(
+        payload_from_json(params).to_block().header,
+        parent_beacon_block_root=beacon_root,
+    )
+    params["blockHash"] = bytes_to_hex(header.hash())
+    req = {
+        "jsonrpc": "2.0",
+        "id": 9,
+        "method": "engine_newPayloadV3",
+        "params": [params, [], bytes_to_hex(beacon_root)],
+    }
+    http, body = handle_request(chain, req)
+    assert http == 200, body
+    assert body["result"]["status"] == "VALID", body
+    assert chain.parent_header.parent_beacon_block_root == beacon_root
+    assert chain.parent_header.excess_blob_gas == 0
+
+    # a wrong expected-blob-hash list must be INVALID before execution
+    chain2 = _fresh_chain()
+    req_bad = {**req, "params": [params, ["0x" + "01" * 32], bytes_to_hex(beacon_root)]}
+    _http, body2 = handle_request(chain2, req_bad)
+    assert body2["result"]["status"] == "INVALID"
+    assert "blob versioned hashes" in body2["result"]["validationError"]

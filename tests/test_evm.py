@@ -321,3 +321,58 @@ def test_native_host_exception_propagates():
             )
     finally:
         set_evm_backend("python")
+
+
+def test_tracer_identical_across_backends():
+    """The per-instruction tracer (Evm.tracer / native PhantHost.trace) is
+    the fixture-divergence debugging surface: the same execution must emit
+    IDENTICAL (pc, op, gas, depth, stack_size) streams on both backends, so
+    a divergence is localized by the first differing step. The reference
+    compiles evmone's tracing.cpp but never installs a tracer (SURVEY §5);
+    here it is wired end to end."""
+    from phant_tpu.backend import set_evm_backend
+    from phant_tpu.evm.native_vm import native_available
+
+    if not native_available():
+        pytest.skip("native toolchain unavailable")
+
+    # nested-call code: parent CALLs child, child SSTOREs + returns
+    child = b"\x31" * 20
+    child_code = bytes.fromhex("600160005560005460005260206000f3")
+    parent_code = bytes.fromhex(
+        "60206000600060006000"
+        + "73" + child.hex()
+        + "61ffff"
+        + "f1"
+        + "60005160005260406000f3"
+    )
+
+    def run(backend):
+        set_evm_backend(backend)
+        state = StateDB({
+            SENDER: Account(balance=10**18),
+            OTHER: Account(code=parent_code),
+            child: Account(code=child_code),
+        })
+        state.start_tx()
+        evm = Evm(_env(state))
+        steps = []
+        evm.tracer = lambda pc, op, gas, depth, sl: steps.append(
+            (pc, op, gas, depth, sl)
+        )
+        res = evm.execute_message(
+            Message(caller=SENDER, target=OTHER, value=0, data=b"", gas=200_000)
+        )
+        assert res.success, (backend, res.error)
+        return steps, res.output
+
+    try:
+        py_steps, py_out = run("python")
+        nat_steps, nat_out = run("native")
+    finally:
+        set_evm_backend("python")
+    assert py_out == nat_out
+    # identical instruction streams — the whole point of the hook
+    assert py_steps == nat_steps
+    assert len(py_steps) > 15  # parent + child frames both traced
+    assert any(d == 1 for (_pc, _op, _g, d, _s) in py_steps)  # child depth
