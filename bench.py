@@ -9,11 +9,25 @@ earlier rounds' device timings into dispatch-rate measurements. Every
 timed region here therefore ends in a forced host readback (`np.asarray`
 of the real result) — the only reliable sync — and the measured tunnel
 characteristics (upload MB/s, round-trip latency) are reported in
-`detail` so the numbers can be interpreted. On this tunnel the host->
-device path runs at ~20 MB/s (vs ~GB/s for locally attached TPUs), which
-rules out winning any workload whose bytes/op is high; the design answer
-is the memoized witness engine below, whose steady-state traffic is only
-the nodes the previous block actually changed.
+`detail` so the numbers can be interpreted.
+
+TUNNEL-RESILIENT ARCHITECTURE (round-4 redesign; round 3 captured zero
+TPU numbers because one dead `jax.devices()` call poisoned the whole
+process): the parent process NEVER initializes jax against the tunnel.
+Every device-touching section runs in a child subprocess
+(`bench.py --section <name>`) with its own wall-clock budget — a child
+hung inside the jax C runtime is simply SIGKILLed, costing its section
+and nothing else. Device sections run FIRST (before CPU baselines spend
+the global budget), each emits its result fragment the moment it
+finishes, and if the tunnel is down at start the bench runs the CPU
+sections and then RETRIES the probe in a loop for the rest of the
+window — detail.tpu_probe_attempts records every attempt with timestamps
+so a dead-all-round tunnel is provable from the artifact. Datasets
+(witness chain, replay chain) are built once outside any watchdog and
+cached on disk under build/bench_cache keyed by shape params, so repeat
+runs spend their tunnel window on transfers and compute, not setup.
+Set PHANT_BENCH_ONLY=engine,ecrecover,... to run a subset section by
+section through a flaky hour.
 
 Headline workload (BASELINE.md config #3/#5 shaped): a chain of blocks
 over an EVOLVING 65536-leaf state trie (each block reads ~32 accounts —
@@ -21,7 +35,7 @@ hot/cold skewed like mainnet — writes 8, and ships a pre-state multiproof
 witness incl. storage subtrees). Every witness is FULLY verified: every
 node keccak256-hashed AND the parent->child hash linkage checked, so the
 witness must form a connected subtree rooted at the block's expected state
-root. Three verifiers are measured on the SAME timed span:
+root. Verifiers measured on the SAME span:
 
   * cpu_baseline — the reference-equivalent cold path: per block, batch-
     keccak every node (native C), scan child refs, check connectivity.
@@ -29,38 +43,76 @@ root. Three verifiers are measured on the SAME timed span:
     design (src/crypto/hasher.zig:4-17, src/mpt/mpt.zig:38-119).
   * headline value — the framework path (`--crypto_backend=tpu`): the
     memoized WitnessEngine (phant_tpu/ops/witness_engine.py), novel-node
-    hashing batched on device, linkage as vectorized integer joins. Warmed
-    on a chain prefix; the timed span pays only for nodes its blocks
-    actually changed — the architecture the north star names.
+    hashing batched on device, linkage as vectorized integer joins.
   * engine-cpu (detail) — the same engine hashing on native C: isolates
     architecture-vs-chip contribution honestly.
+  * engine_cached_ceiling (detail) — the engine with every span node
+    already interned: the zero-novel-work steady state (pure linkage).
 
 The cold fused device kernel (everything incl. RLP ref parsing on device,
-ops/witness_jax.py witness_verify_fused) is also timed honestly — forced
-readback per batch — and reported as detail.device_cold_blocks_per_sec.
+ops/witness_jax.py witness_verify_fused) is timed honestly per batch, and
+additionally with device-RESIDENT witness bytes (upload once, repeated
+verify dispatches, pipelined) — the rate a locally-attached chip would
+see, since on this tunnel upload dominates end-to-end.
 
-Secondary metrics in "detail": state-root recompute p50 latency (BASELINE.md
-metric #2), a 1000-block mainnet replay through the full run_block path
-(BASELINE.md config #5; reference: src/blockchain/blockchain.zig:61-205),
-and the batched-ecrecover rate (config #4).
+Secondary metrics in "detail": state-root recompute p50 (BASELINE.md
+metric #2; single root AND the K-roots-per-dispatch batched variant with
+an explicit routing verdict), a 1000-block mainnet replay through the
+full run_block path as four separately-budgeted sections (config #5;
+reference hot loop src/blockchain/blockchain.zig:61-205), batched
+ecrecover (config #4; the GLV half-width ladder at B=1024 on device),
+and the keccak microbench (config #2).
 
-Platform selection is loud: if the environment points at a TPU
-(JAX_PLATFORMS mentions axon/tpu) the probe retries hard, and a fallback to
-CPU is flagged in detail.tpu_expected_but_absent (set
-PHANT_BENCH_REQUIRE_TPU=1 to hard-fail instead) — a broken tunnel must
-never silently masquerade as a CPU baseline number again (round-1 lesson).
+Platform selection is loud: a broken tunnel degrades to CPU only with
+detail.tpu_expected_but_absent set (PHANT_BENCH_REQUIRE_TPU=1 hard-fails
+instead) — a dead tunnel must never masquerade as a CPU baseline.
 """
 
 from __future__ import annotations
 
 import json
 import os
+import pickle
+import subprocess
 import sys
 import time
 
 import numpy as np
 
-from phant_tpu.ops.witness_jax import WITNESS_MAX_CHUNKS as MAX_CHUNKS
+# keccak absorb capacity of the witness kernels: 5 rate-chunks = 680B,
+# covering every RLP trie-node size (mirrors ops/witness_jax.py
+# WITNESS_MAX_CHUNKS without importing jax into the parent process)
+MAX_CHUNKS = 5
+
+_CACHE_SCHEMA = 4  # bump to invalidate build/bench_cache pickles
+
+
+# ---------------------------------------------------------------------------
+# datasets (CPU-only construction; disk-cached so repeat runs spend their
+# tunnel window on the chip, not on host-side setup)
+# ---------------------------------------------------------------------------
+
+
+def _cache_path(name: str) -> str:
+    d = os.path.join(os.path.dirname(os.path.abspath(__file__)), "build", "bench_cache")
+    os.makedirs(d, exist_ok=True)
+    return os.path.join(d, name)
+
+
+def _cached(name: str, builder):
+    path = _cache_path(f"{name}_v{_CACHE_SCHEMA}.pkl")
+    if os.path.exists(path):
+        try:
+            with open(path, "rb") as f:
+                return pickle.load(f)
+        except Exception:
+            pass  # corrupt/stale cache: rebuild
+    obj = builder()
+    tmp = path + f".tmp{os.getpid()}"
+    with open(tmp, "wb") as f:
+        pickle.dump(obj, f, protocol=pickle.HIGHEST_PROTOCOL)
+    os.replace(tmp, path)
+    return obj
 
 
 def build_witnesses(
@@ -210,470 +262,25 @@ def build_witness_chain(
     return chain
 
 
-class _SectionTimeout(Exception):
-    pass
-
-
-class _watchdog:
-    """SIGALRM guard around device-touching bench sections.
-
-    Coverage is Python-level stalls only: the signal interrupts retry loops
-    and between-dispatch code, but a call blocked INSIDE the jax C runtime
-    (e.g. a transfer hung on a dropped tunnel) does not return to the
-    interpreter, so the exception cannot fire there. The process-wide
-    guarantee that the driver always gets a JSON line is the global
-    deadline thread (_arm_global_deadline), which force-exits after
-    printing whatever was measured so far."""
-
-    def __init__(self, seconds: int | None = None):
-        self.seconds = seconds or int(
-            os.environ.get("PHANT_BENCH_SECTION_TIMEOUT", "480")
-        )
-
-    def __enter__(self):
-        import signal
-
-        def fire(_sig, _frm):
-            raise _SectionTimeout(f"device section exceeded {self.seconds}s")
-
-        self._old = signal.signal(signal.SIGALRM, fire)
-        signal.alarm(self.seconds)
-        return self
-
-    def __exit__(self, *exc):
-        import signal
-
-        signal.alarm(0)
-        signal.signal(signal.SIGALRM, self._old)
-        return False
-
-
-_PARTIAL = {"detail": {}}  # progressively filled; the global deadline prints it
-
-
-def _arm_global_deadline() -> None:
-    """Daemon thread: if the whole bench exceeds PHANT_BENCH_GLOBAL_TIMEOUT
-    (default 2400s — a hung C-level jax call is immune to SIGALRM), print
-    the JSON line from everything measured so far, annotated, and exit.
-    The driver must ALWAYS receive one JSON line."""
-    import threading
-
-    deadline = float(os.environ.get("PHANT_BENCH_GLOBAL_TIMEOUT", "2400"))
-
-    def fire():
-        detail = dict(_PARTIAL.get("detail", {}))
-        detail["global_deadline_hit_s"] = deadline
-        print(
-            json.dumps(
-                {
-                    "metric": "block_witness_verifications_per_sec",
-                    "value": _PARTIAL.get("value", 0.0),
-                    "unit": "blocks/s",
-                    "vs_baseline": _PARTIAL.get("vs_baseline", 0.0),
-                    "detail": detail,
-                }
-            ),
-            flush=True,
-        )
-        os._exit(0)
-
-    t = threading.Timer(deadline, fire)
-    t.daemon = True
-    t.start()
-
-
-def _native_hasher():
-    """Native C batched keccak as a WitnessEngine hasher (None if no lib)."""
-    from phant_tpu.utils.native import load_native
-
-    native = load_native()
-    if native is None:
-        return None
-    return lambda nodes: native.keccak256_batch(nodes)
-
-
-def _tunnel_probe(platform: str) -> dict:
-    """Measured device-link characteristics (upload MB/s, round-trip ms) so
-    the device numbers can be interpreted: a tunneled chip is ~3 orders of
-    magnitude slower to feed than a locally attached one. Reports the SAME
-    measurement the adaptive offload routing used
-    (phant_tpu/backend.py device_link_profile)."""
-    if platform == "cpu":
-        return {}
-    try:
-        from phant_tpu.backend import device_link_profile
-
-        up_bps, rtt = device_link_profile()
-        return {
-            "tunnel_upload_mbps": round(up_bps / 1e6, 1),
-            "tunnel_roundtrip_ms": round(rtt * 1e3, 1),
-        }
-    except Exception as e:
-        return {"tunnel_probe_error": repr(e)[:120]}
-
-
-def verify_cpu(witnesses) -> int:
-    """CPU baseline: FULL linked verification per block on the native path —
-    batch keccak every node, scan child refs (C++ RLP scanner), and check
-    that every node is the root or hash-referenced by a same-block node
-    (equivalent to subtree connectivity: hash references are acyclic).
-    Returns the number of verified blocks."""
-    from phant_tpu.utils.native import load_native
-
-    native = load_native()
-    if native is None:  # no toolchain: slower pure-Python full check
-        from phant_tpu.mpt.proof import verify_witness_linked
-
-        return sum(bool(verify_witness_linked(r, n)) for r, n in witnesses)
-
-    ok = 0
-    for root, nodes in witnesses:
-        digests = native.keccak256_batch(nodes)
-        raw = b"".join(nodes)
-        lens = np.asarray([len(n) for n in nodes], np.uint32)
-        offsets = np.zeros(len(nodes), np.uint64)
-        if len(nodes) > 1:
-            offsets[1:] = np.cumsum(lens[:-1])
-        blob = np.frombuffer(raw, np.uint8)
-        ref_off, _ref_node = native.scan_refs(blob, offsets, lens)
-        refset = {raw[o : o + 32] for o in ref_off.tolist()}
-        if root in set(digests) and all(
-            d == root or d in refset for d in digests
-        ):
-            ok += 1
-    return ok
-
-
-def _pick_platform():
-    """(platform, error) — probe the tunneled TPU in throwaway subprocesses.
-
-    A broken tunnel degrades to a CPU run ONLY with a loud annotation (the
-    returned error string lands in detail.tpu_expected_but_absent); with
-    PHANT_BENCH_REQUIRE_TPU=1 it aborts instead."""
-    import subprocess
-
-    env_platforms = os.environ.get("JAX_PLATFORMS", "")
-    tpu_expected = any(p in env_platforms for p in ("axon", "tpu")) or bool(
-        os.environ.get("PALLAS_AXON_POOL_IPS")
-    )
-    if not tpu_expected:
-        return "cpu", None
-
-    attempts = int(os.environ.get("PHANT_BENCH_PROBE_RETRIES", "3"))
-    probe_timeout = float(os.environ.get("PHANT_BENCH_PROBE_TIMEOUT", "240"))
-    last_err = "unknown"
-    for i in range(attempts):
-        try:
-            probe = subprocess.run(
-                [
-                    sys.executable,
-                    "-c",
-                    "import jax; d = jax.devices(); "
-                    "import jax.numpy as jnp; "
-                    "x = (jnp.ones((8, 8)) @ jnp.ones((8, 8))).block_until_ready(); "
-                    "print(d[0].platform)",
-                ],
-                capture_output=True,
-                text=True,
-                timeout=probe_timeout,
-            )
-            if probe.returncode == 0 and probe.stdout.strip():
-                plat = probe.stdout.strip().splitlines()[-1]
-                if plat != "cpu":
-                    return plat, None
-                last_err = "probe returned cpu despite TPU env"
-            else:
-                last_err = (probe.stderr or "empty probe output")[-300:]
-        except subprocess.TimeoutExpired:
-            last_err = f"probe timed out after {probe_timeout}s (attempt {i + 1}/{attempts})"
-        print(f"[bench] TPU probe attempt {i + 1}/{attempts} failed: {last_err}", file=sys.stderr)
-    msg = f"TPU expected ({env_platforms!r}) but unreachable: {last_err}"
-    if os.environ.get("PHANT_BENCH_REQUIRE_TPU"):
-        print(f"[bench] FATAL: {msg}", file=sys.stderr)
-        sys.exit(2)
-    return "cpu", msg
-
-
-def main() -> None:
-    platform, tpu_err = _pick_platform()
-    _arm_global_deadline()
-    import jax
-
-    from phant_tpu.utils.jaxcache import enable_compile_cache
-
-    enable_compile_cache()
-
-    if platform == "cpu":
-        # the axon sitecustomize pins jax_platforms; override like the tests
-        os.environ["JAX_PLATFORMS"] = "cpu"
-        os.environ.pop("PALLAS_AXON_POOL_IPS", None)
-        jax.config.update("jax_platforms", "cpu")
-    import jax.numpy as jnp
-
-    from phant_tpu.ops.witness_jax import (
-        pack_witness_fused,
-        roots_to_words,
-        witness_verify_fused,
-    )
-
-    # mainnet-like shapes (round-2 weak #7): 65536-leaf evolving state trie
-    # gives 5-6 nodes per account path incl. ~532B branch nodes, storage
-    # subtree proofs hash-linked through account leaves, and realistic
-    # consecutive-witness overlap (only written paths change)
+def _witness_chain() -> tuple:
+    """(warm, span) witness chain at the env-selected shapes, disk-cached."""
     warm_blocks = int(os.environ.get("PHANT_BENCH_WARM", "256"))
     span_blocks = int(os.environ.get("PHANT_BENCH_BLOCKS", "256"))
     trie_size = int(os.environ.get("PHANT_BENCH_TRIE", "65536"))
-    chain = build_witness_chain(
-        warm_blocks + span_blocks,
-        trie_size=trie_size,
-        reads=int(os.environ.get("PHANT_BENCH_ACCOUNTS", "32")),
-        writes=8,
-        storage_slots=4096,
-        storage_reads_per_block=8,
-    )
-    warm, span = chain[:warm_blocks], chain[warm_blocks:]
-    node_lists = [nodes for _root, nodes in span]
-    n_blocks = span_blocks
-
-    # --- CPU baseline: reference-equivalent cold verification --------------
-    verify_cpu(span[:4])  # warm the native lib
-    cpu_s = float("inf")
-    for _ in range(2):
-        t0 = time.perf_counter()
-        ok_cpu = verify_cpu(span)
-        cpu_s = min(cpu_s, time.perf_counter() - t0)
-        assert ok_cpu == n_blocks
-    cpu_rate = n_blocks / cpu_s
-
-    # --- framework path: memoized engine behind --crypto_backend=tpu -------
-    from phant_tpu.backend import set_crypto_backend
-    from phant_tpu.ops.witness_engine import WitnessEngine
-
-    batch = int(os.environ.get("PHANT_BENCH_ENGINE_BATCH", "64"))
-
-    def run_engine(hasher=None, backend=None, eng_batch=None) -> tuple:
-        """Warm on the prefix, then time the span (verdicts are host numpy —
-        the digest readbacks inside intern() make this sync-honest)."""
-        b = eng_batch or batch
-        if backend:
-            set_crypto_backend(backend)
-        try:
-            eng = WitnessEngine(hasher=hasher)
-            for i in range(0, len(warm), b):
-                assert eng.verify_batch(warm[i : i + b]).all()
-            warm_hashed = eng.stats["hashed"]
-            t0 = time.perf_counter()
-            for i in range(0, len(span), b):
-                assert eng.verify_batch(span[i : i + b]).all()
-            dt = time.perf_counter() - t0
-            return dt, eng.stats["hashed"] - warm_hashed, eng.stats
-        finally:
-            if backend:
-                set_crypto_backend("cpu")
-
-    # engine on native C hashing (architecture-only contribution)
-    ecpu_s, novel, _st = run_engine(hasher=_native_hasher())
-    _PARTIAL["detail"]["cpu_baseline_blocks_per_sec"] = round(cpu_rate, 2)
-    _PARTIAL["detail"]["engine_cpu_blocks_per_sec"] = round(n_blocks / ecpu_s, 2)
-    _PARTIAL["value"] = round(n_blocks / ecpu_s, 2)
-    _PARTIAL["vs_baseline"] = round((n_blocks / ecpu_s) / cpu_rate, 2)
-    device_err = None
-    edev_s, rstats, efrc_s = ecpu_s, {}, None
-    if platform != "cpu":
-        try:
-            with _watchdog():
-                # the product path: --crypto_backend=tpu with adaptive
-                # link-aware routing (ships a novel batch to the chip only
-                # when the measured link says it beats the native hasher)
-                edev_s, novel, rstats = run_engine(backend="tpu")
-            _PARTIAL["value"] = round(n_blocks / edev_s, 2)
-            _PARTIAL["vs_baseline"] = round((n_blocks / edev_s) / cpu_rate, 2)
-        except Exception as e:
-            device_err = repr(e)[:200]
-            edev_s, rstats = ecpu_s, {}
-        if device_err is None:  # don't burn a watchdog on a known-dead device
-            try:
-                with _watchdog():
-                    # transparency: the device FORCED on every novel batch —
-                    # its failure must not clobber the routed result above
-                    efrc_s, _n, _s = run_engine(
-                        hasher=WitnessEngine._hash_batch_device, eng_batch=256
-                    )
-            except Exception as e:
-                device_err = repr(e)[:200]
-                efrc_s = None
-    dev_rate = n_blocks / edev_s
-
-    # --- cold fused device kernel (no memoization), honest sync ------------
-    cold_rate = None
-    if platform != "cpu" and device_err is None:
-        try:
-            with _watchdog():
-                _, meta0 = pack_witness_fused(node_lists, MAX_CHUNKS)
-                pad_nodes = meta0.shape[1]
-                roots_d = jnp.asarray(roots_to_words([r for r, _ in span]))
-
-                def dispatch():
-                    blob, meta16 = pack_witness_fused(
-                        node_lists, MAX_CHUNKS, pad_nodes_to=pad_nodes
-                    )
-                    return witness_verify_fused(
-                        jnp.asarray(blob),
-                        jnp.asarray(meta16),
-                        roots_d,
-                        max_chunks=MAX_CHUNKS,
-                        n_blocks=n_blocks,
-                    )
-
-                ok0 = int(np.asarray(dispatch()).sum())  # compile + check
-                assert ok0 == n_blocks
-                cold_s = float("inf")
-                for _ in range(2):
-                    t0 = time.perf_counter()
-                    ok_dev = int(np.asarray(dispatch()).sum())  # forced sync
-                    cold_s = min(cold_s, time.perf_counter() - t0)
-                    assert ok_dev == n_blocks, f"device {ok_dev}/{n_blocks}"
-                cold_rate = n_blocks / cold_s
-        except Exception as e:
-            device_err = repr(e)[:200]
-
-    detail = _PARTIAL["detail"]  # the global deadline prints this dict as-is
-    _PARTIAL["value"] = round(dev_rate, 2)
-    _PARTIAL["vs_baseline"] = round(dev_rate / cpu_rate, 2)
-    detail |= {
-        "backend": jax.devices()[0].platform,
-        "timing": "forced-readback",
-        "cpu_baseline_blocks_per_sec": round(cpu_rate, 2),
-        "engine_cpu_blocks_per_sec": round(n_blocks / ecpu_s, 2),
-        "novel_nodes_per_block": round(novel / n_blocks, 1) if novel else None,
-        "nodes_per_block": round(sum(len(n) for n in node_lists) / n_blocks, 1),
-        "witness_bytes_per_block": round(
-            sum(len(n) for nl in node_lists for n in nl) / n_blocks
+    reads = int(os.environ.get("PHANT_BENCH_ACCOUNTS", "32"))
+    key = f"wchain_{warm_blocks + span_blocks}_{trie_size}_{reads}"
+    chain = _cached(
+        key,
+        lambda: build_witness_chain(
+            warm_blocks + span_blocks,
+            trie_size=trie_size,
+            reads=reads,
+            writes=8,
+            storage_slots=4096,
+            storage_reads_per_block=8,
         ),
-        "verification": "linked-multiproof-memoized",
-    }
-    if rstats:
-        detail["routing"] = {
-            "device_batches": rstats.get("device_batches", 0),
-            "native_batches": rstats.get("native_batches", 0),
-        }
-    if efrc_s is not None:
-        detail["engine_tpu_forced_blocks_per_sec"] = round(n_blocks / efrc_s, 2)
-    if cold_rate is not None:
-        detail["device_cold_blocks_per_sec"] = round(cold_rate, 2)
-    if device_err is not None:
-        detail["device_section_error"] = device_err
-    detail.update(_tunnel_probe(platform))
-    if tpu_err:
-        detail["tpu_expected_but_absent"] = tpu_err
-    detail.update(bench_state_root(platform))
-    detail.update(bench_replay(platform))
-    detail.update(bench_ecrecover(platform))
-    detail.update(bench_keccak(platform))
-    print(
-        json.dumps(
-            {
-                "metric": "block_witness_verifications_per_sec",
-                "value": round(dev_rate, 2),
-                "unit": "blocks/s",
-                "vs_baseline": round(dev_rate / cpu_rate, 2),
-                "detail": detail,
-            }
-        )
     )
-
-
-def bench_state_root(platform: str) -> dict:
-    """BASELINE.md metric #2: state-root recompute p50 latency over a
-    mainnet-block-sized account trie, CPU recursion vs the device level-order
-    pipeline (phant_tpu/ops/mpt_jax.py). Both sides recompute every node hash
-    from a built trie (the reference recomputes roots from scratch per block,
-    src/mpt/mpt.zig:38-45 — and skips the state root entirely,
-    src/blockchain/blockchain.zig:83-85)."""
-    if os.environ.get("PHANT_BENCH_STATE_ROOT", "1") in ("0", ""):
-        return {}
-    try:
-        with _watchdog():
-            return _bench_state_root_inner(platform)
-    except Exception as e:
-        return {"state_root_error": repr(e)[:200]}
-
-
-def _bench_state_root_inner(platform: str) -> dict:
-    try:
-        from phant_tpu import rlp
-        from phant_tpu.crypto.keccak import keccak256
-        from phant_tpu.mpt.mpt import Trie
-        from phant_tpu.ops.mpt_jax import (
-            build_hash_plan,
-            execute_plan_host,
-            trie_root_device,
-        )
-
-        rng = np.random.default_rng(11)
-        trie = Trie()
-        n_accounts = int(os.environ.get("PHANT_BENCH_SR_ACCOUNTS", "2048"))
-        for _ in range(n_accounts):
-            leaf = rlp.encode(
-                [
-                    rlp.encode_uint(int(rng.integers(0, 1000))),
-                    rlp.encode_uint(int(rng.integers(0, 10**18))),
-                    rng.bytes(32),
-                    rng.bytes(32),
-                ]
-            )
-            trie.put(keccak256(rng.bytes(20)), leaf)
-
-        reps = 11 if platform != "cpu" else 3
-        expected = trie.root_hash()
-
-        # Symmetric comparison: the SAME value-complete, hash-free plan on
-        # both sides; each rep recomputes EVERY node digest (the stateless
-        # workload — claimed state is untrusted, nothing is reusable). CPU
-        # runs the host plan executor (native batched keccak, no RLP
-        # re-encoding); device runs the single fused dispatch.
-        plan = build_hash_plan(trie)
-        assert plan is not None
-
-        assert execute_plan_host(plan) == expected  # warm native lib
-        cpu_t = []
-        for _ in range(reps):
-            t0 = time.perf_counter()
-            assert execute_plan_host(plan) == expected
-            cpu_t.append(time.perf_counter() - t0)
-
-        # transparency: the cold full-walk root (encode + hash) the block
-        # path runs when no plan exists
-        cold_t = []
-        for _ in range(3):
-            trie._enc_cache.clear()
-            t0 = time.perf_counter()
-            assert trie.root_hash() == expected
-            cold_t.append(time.perf_counter() - t0)
-
-        out = {
-            "state_root_cpu_p50_ms": round(float(np.median(cpu_t)) * 1e3, 2),
-            "state_root_cpu_coldwalk_p50_ms": round(
-                float(np.median(cold_t)) * 1e3, 2
-            ),
-            "state_root_accounts": n_accounts,
-        }
-        if platform != "cpu":
-            # the device recompute number only means something with a real
-            # accelerator attached; on a cpu fallback run the jax-cpu
-            # "device" path is just a minutes-long compile for a non-number
-            trie_root_device(trie, plan)  # compile + device-residency
-            dev_t = []
-            for _ in range(reps):
-                t0 = time.perf_counter()
-                assert trie_root_device(trie, plan) == expected
-                dev_t.append(time.perf_counter() - t0)
-            out["state_root_tpu_p50_ms"] = round(
-                float(np.median(dev_t)) * 1e3, 2
-            )
-        return out
-    except Exception as e:
-        return {"state_root_error": repr(e)[:200]}
+    return chain[:warm_blocks], chain[warm_blocks:]
 
 
 def _build_replay_chain(n_blocks: int, txs_per_block: int):
@@ -681,15 +288,19 @@ def _build_replay_chain(n_blocks: int, txs_per_block: int):
     transfers PLUS contract calls that SLOAD+SSTORE a counter (cold account
     + cold slot per tx under EIP-2929), so the replay exercises the EVM
     storage path, receipts with variable gas, and an evolving contract
-    storage trie — not just balance arithmetic (round-2 review: the replay
-    chain was value-transfers only). Headers carry the exact gas/roots the
-    replay must recompute, derived from actually executing each block on a
-    builder chain (reference scope: src/blockchain/blockchain.zig:61-96,
-    which TODO-disables the state-root check this bench re-enables)."""
+    storage trie — not just balance arithmetic. Headers carry the exact
+    gas/roots the replay must recompute, derived from actually executing
+    each block on a builder chain (reference scope:
+    src/blockchain/blockchain.zig:61-96, which TODO-disables the
+    state-root check this bench re-enables).
+
+    Returns a PICKLABLE tuple (genesis, blocks, genesis_accounts,
+    total_txs, n_calls) — the disk cache moves chain construction out of
+    every future bench run's budget entirely."""
     from phant_tpu.blockchain.chain import calculate_base_fee
     from phant_tpu.crypto import secp256k1 as secp
     from phant_tpu.mpt.mpt import EMPTY_TRIE_ROOT, ordered_trie_root
-    from phant_tpu.signer.signer import TxSigner
+    from phant_tpu.signer.signer import TxSigner, address_from_pubkey
     from phant_tpu.state.statedb import StateDB
     from phant_tpu.types.account import Account
     from phant_tpu.types.block import Block, BlockHeader
@@ -706,8 +317,6 @@ def _build_replay_chain(n_blocks: int, txs_per_block: int):
     senders = []
     genesis_accounts = {}
     for k in keys:
-        from phant_tpu.signer.signer import address_from_pubkey
-
         addr = address_from_pubkey(secp.pubkey_of(k))
         senders.append(addr)
         genesis_accounts[addr] = Account(balance=10**24)
@@ -731,20 +340,20 @@ def _build_replay_chain(n_blocks: int, txs_per_block: int):
         withdrawals_root=EMPTY_TRIE_ROOT,
     )
 
-    def fresh_state() -> StateDB:
-        return StateDB({a: acct.copy() for a, acct in genesis_accounts.items()})
-
     # build blocks by EXECUTING them on a builder chain, so every header
     # carries its real post-state root (the replay can then be benchmarked
     # with full state-root verification — a check the reference client
     # TODO-disables entirely, src/blockchain/blockchain.zig:83-85)
+    from dataclasses import replace
+
     from phant_tpu.blockchain.chain import Blockchain
 
-    builder_state = fresh_state()
+    builder_state = StateDB(
+        {a: acct.copy() for a, acct in genesis_accounts.items()}
+    )
     builder = Blockchain(chain_id, builder_state, genesis, verify_state_root=False)
     blocks = []
     parent = genesis
-    from dataclasses import replace
 
     for b in range(1, n_blocks + 1):
         txs = []
@@ -795,232 +404,1049 @@ def _build_replay_chain(n_blocks: int, txs_per_block: int):
         blocks.append(Block(header=header, transactions=tuple(txs), withdrawals=()))
         parent = header
 
-    return genesis, blocks, fresh_state, txs_per_block + n_calls, n_calls
+    return genesis, blocks, genesis_accounts, txs_per_block + n_calls, n_calls
 
 
-def bench_replay(platform: str) -> dict:
-    """BASELINE.md config #5: n-block mainnet replay through the FULL
-    run_block path (batched ecrecover + EVM execution + tx/receipt/
-    withdrawal root checks), cpu vs tpu crypto backends (reference hot loop:
-    src/blockchain/blockchain.zig:61-205)."""
-    if os.environ.get("PHANT_BENCH_REPLAY", "1") in ("0", ""):
-        return {}
-    try:
-        with _watchdog():
-            return _bench_replay_inner(platform)
-    except Exception as e:
-        return {"replay_error": repr(e)[:200]}
+def _replay_chain() -> tuple:
+    """Disk-cached replay chain at the env-selected shapes. Construction
+    executes every block with the best available EVM backend (builder) —
+    expensive, hence the cache; if a stale cache fails to replay, callers
+    delete the file and rebuild."""
+    from phant_tpu.backend import set_evm_backend
+    from phant_tpu.evm.native_vm import native_available
 
+    n_blocks = int(os.environ.get("PHANT_REPLAY_BLOCKS", "1000"))
+    txs_per_block = int(os.environ.get("PHANT_REPLAY_TXS", "8"))
+    key = f"rchain_{n_blocks}_{txs_per_block}"
 
-def _bench_replay_inner(platform: str) -> dict:
-    try:
-        from phant_tpu.backend import set_crypto_backend, set_evm_backend
-        from phant_tpu.blockchain.chain import Blockchain
-        from phant_tpu.evm.native_vm import native_available
-
-        n_blocks = int(os.environ.get("PHANT_REPLAY_BLOCKS", "1000"))
-        txs_per_block = int(os.environ.get("PHANT_REPLAY_TXS", "8"))
+    def build():
         if native_available():
-            set_evm_backend("native")  # builder executes every block too
-        genesis, blocks, fresh_state, total_txs, n_calls = _build_replay_chain(
-            n_blocks, txs_per_block
-        )
-
-        def replay(backend: str, verify_root: bool = False) -> float:
-            set_crypto_backend(backend)
-            chain = Blockchain(
-                1, fresh_state(), genesis, verify_state_root=verify_root
-            )
-            t0 = time.perf_counter()
-            # run_blocks pipelines device sender recovery across blocks on
-            # the tpu backend and is a plain loop on cpu
-            chain.run_blocks(blocks)
-            return time.perf_counter() - t0
-
-        # warm both paths on a short prefix (compile device buckets)
-        out = {}
-        cpu_s = replay("cpu")
-        out["replay_cpu_blocks_per_sec"] = round(n_blocks / cpu_s, 1)
-        tpu_s = replay("tpu")
-        out["replay_tpu_blocks_per_sec"] = round(n_blocks / tpu_s, 1)
-        # full validation INCLUDING per-block state-root verification over
-        # the incremental StateDB trie — the check the reference client
-        # TODO-disables (src/blockchain/blockchain.zig:83-85)
-        sr_s = replay("cpu", verify_root=True)
-        out["replay_stateroot_cpu_blocks_per_sec"] = round(n_blocks / sr_s, 1)
-        sr_t = replay("tpu", verify_root=True)
-        out["replay_stateroot_tpu_blocks_per_sec"] = round(n_blocks / sr_t, 1)
-        out["replay_blocks"] = n_blocks
-        out["replay_txs_per_block"] = total_txs
-        out["replay_contract_calls_per_block"] = n_calls
-        return out
-    except Exception as e:
-        return {"replay_error": repr(e)[:200]}
-    finally:
+            set_evm_backend("native")
         try:
-            from phant_tpu.backend import set_crypto_backend, set_evm_backend
-
-            set_crypto_backend("cpu")
+            return _build_replay_chain(n_blocks, txs_per_block)
+        finally:
             set_evm_backend("python")
-        except Exception:
-            pass
+
+    return _cached(key, build)
 
 
-def bench_keccak(platform: str) -> dict:
-    """BASELINE.md config #2: standalone keccak256 microbench over N
-    variable-length payloads (32-576B, the RLP trie-node range), device
-    batch kernel vs the native C batch — hashes/s, warm, best-of-N."""
-    if os.environ.get("PHANT_BENCH_KECCAK", "1") in ("0", ""):
-        return {}
-    try:
-        with _watchdog():
-            return _bench_keccak_inner(platform)
-    except Exception as e:
-        return {"keccak_error": repr(e)[:200]}
+# ---------------------------------------------------------------------------
+# watchdogs / partial-result plumbing
+# ---------------------------------------------------------------------------
 
 
-def _bench_keccak_inner(platform: str) -> dict:
-    try:
-        import jax.numpy as jnp
+class _SectionTimeout(Exception):
+    pass
 
-        from phant_tpu.ops.keccak_jax import (
-            digests_to_bytes,
-            keccak256_chunked,
-            pack_payloads,
+
+class _watchdog:
+    """SIGALRM guard around bench sections (in-process stalls only; a call
+    hung inside the jax C runtime never returns to the interpreter, which
+    is why device sections additionally run in killable subprocesses)."""
+
+    def __init__(self, seconds: int | None = None):
+        self.seconds = seconds or int(
+            os.environ.get("PHANT_BENCH_SECTION_TIMEOUT", "480")
         )
-        from phant_tpu.utils.native import load_native
 
-        rng = np.random.default_rng(17)
-        N = int(os.environ.get("PHANT_BENCH_KECCAK_N", "16384"))
-        payloads = [rng.bytes(int(rng.integers(32, 577))) for _ in range(N)]
-        reps = 5
+    def __enter__(self):
+        import signal
 
-        native = load_native()
-        if native is not None:
-            want = native.keccak256_batch(payloads)  # warm
-            cpu_s = float("inf")
-            for _ in range(reps):
-                t0 = time.perf_counter()
-                native.keccak256_batch(payloads)
-                cpu_s = min(cpu_s, time.perf_counter() - t0)
-        else:
-            from phant_tpu.crypto.keccak import keccak256
+        def fire(_sig, _frm):
+            raise _SectionTimeout(f"section exceeded {self.seconds}s")
 
-            t0 = time.perf_counter()
-            want = [keccak256(p) for p in payloads]
-            cpu_s = time.perf_counter() - t0
+        self._old = signal.signal(signal.SIGALRM, fire)
+        signal.alarm(self.seconds)
+        return self
 
-        # end-to-end device path: host pack -> transfer -> hash -> readback
-        def run():
-            words, nchunks, C = pack_payloads(payloads, 5)
-            out = keccak256_chunked(
-                jnp.asarray(words), jnp.asarray(nchunks), max_chunks=5
-            )
-            return digests_to_bytes(np.asarray(out))
+    def __exit__(self, *exc):
+        import signal
 
-        got = run()  # compile + warm
-        assert got == want, "device keccak mismatch vs native"
-        dev_s = float("inf")
-        for _ in range(reps):
-            t0 = time.perf_counter()
-            run()
-            dev_s = min(dev_s, time.perf_counter() - t0)
+        signal.alarm(0)
+        signal.signal(signal.SIGALRM, self._old)
+        return False
 
-        # compute-only rate with the payloads already resident in HBM (what
-        # a locally attached chip sees, where upload is ~free): dispatch +
-        # verdict readback, honest sync via np.asarray
-        words, nchunks, C = pack_payloads(payloads, 5)
-        wd, nd = jnp.asarray(words), jnp.asarray(nchunks)
-        np.asarray(keccak256_chunked(wd, nd, max_chunks=5))  # warm
-        res_s = float("inf")
-        for _ in range(reps):
-            t0 = time.perf_counter()
-            np.asarray(keccak256_chunked(wd, nd, max_chunks=5))
-            res_s = min(res_s, time.perf_counter() - t0)
+
+_PARTIAL = {"detail": {}}  # progressively filled; the global deadline prints it
+_CHILDREN: list = []  # live child Popen handles, killed on forced exit
+
+
+def _pin_jax_cpu() -> None:
+    """Force jax onto the host CPU for inline (non-child) device sections:
+    the axon sitecustomize registers the tunnel backend at interpreter
+    startup and the jax_platforms CONFIG it leaves behind outranks the
+    JAX_PLATFORMS env var — without this pin, a dead tunnel hangs the
+    XLA-CPU fallback path in jax.default_backend() (r3's exact failure
+    mode, rediscovered in the r4 rewrite)."""
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from phant_tpu.utils.jaxcache import enable_compile_cache
+
+    enable_compile_cache()
+
+
+def _log(msg: str) -> None:
+    print(f"[bench] {msg}", file=sys.stderr, flush=True)
+
+
+def _emit_final() -> None:
+    detail = _PARTIAL.get("detail", {})
+    print(
+        json.dumps(
+            {
+                "metric": "block_witness_verifications_per_sec",
+                "value": _PARTIAL.get("value", 0.0),
+                "unit": "blocks/s",
+                "vs_baseline": _PARTIAL.get("vs_baseline", 0.0),
+                "detail": detail,
+            }
+        ),
+        flush=True,
+    )
+
+
+def _arm_global_deadline() -> None:
+    """Daemon thread: if the whole bench exceeds PHANT_BENCH_GLOBAL_TIMEOUT
+    (default 2400s), print the JSON line from everything measured so far,
+    kill any live children, and exit. The driver must ALWAYS receive one
+    JSON line."""
+    import threading
+
+    deadline = float(os.environ.get("PHANT_BENCH_GLOBAL_TIMEOUT", "2400"))
+
+    def fire():
+        _PARTIAL["detail"]["global_deadline_hit_s"] = deadline
+        for p in _CHILDREN:
+            try:
+                p.kill()
+            except Exception:
+                pass
+        _emit_final()
+        os._exit(0)
+
+    t = threading.Timer(deadline, fire)
+    t.daemon = True
+    t.start()
+
+
+def _native_hasher():
+    """Native C batched keccak as a WitnessEngine hasher (None if no lib)."""
+    from phant_tpu.utils.native import load_native
+
+    native = load_native()
+    if native is None:
+        return None
+    return lambda nodes: native.keccak256_batch(nodes)
+
+
+def _tunnel_profile() -> dict:
+    """Measured device-link characteristics (upload MB/s, round-trip ms) —
+    the SAME measurement the adaptive offload routing uses
+    (phant_tpu/backend.py device_link_profile)."""
+    try:
+        from phant_tpu.backend import device_link_profile
+
+        up_bps, rtt = device_link_profile()
         return {
-            "keccak_hashes_per_sec": round(N / dev_s, 1),
-            "keccak_device_resident_hashes_per_sec": round(N / res_s, 1),
-            "keccak_cpu_hashes_per_sec": round(N / cpu_s, 1),
-            "keccak_batch": N,
+            "tunnel_upload_mbps": round(up_bps / 1e6, 1),
+            "tunnel_roundtrip_ms": round(rtt * 1e3, 1),
         }
     except Exception as e:
-        return {"keccak_error": repr(e)[:200]}
+        return {"tunnel_probe_error": repr(e)[:120]}
 
 
-def bench_ecrecover(platform: str = "tpu") -> dict:
-    """BASELINE.md config #4: batched sender recovery for a block's tx list.
-    Device = the fused secp256k1+keccak kernel; CPU baseline = the native
-    batch (reference scope: src/crypto/ecdsa.zig:19-26 per tx)."""
-    if os.environ.get("PHANT_BENCH_ECRECOVER", "1") in ("0", ""):
-        return {}
-    try:
-        # cold ladder compiles can exceed the default watchdog; give this
-        # section the compile headroom the others don't need
-        with _watchdog(
-            int(os.environ.get("PHANT_BENCH_ECRECOVER_TIMEOUT", "900"))
+def verify_cpu(witnesses) -> int:
+    """CPU baseline: FULL linked verification per block on the native path —
+    batch keccak every node, scan child refs (C++ RLP scanner), and check
+    that every node is the root or hash-referenced by a same-block node
+    (equivalent to subtree connectivity: hash references are acyclic).
+    Returns the number of verified blocks."""
+    from phant_tpu.utils.native import load_native
+
+    native = load_native()
+    if native is None:  # no toolchain: slower pure-Python full check
+        from phant_tpu.mpt.proof import verify_witness_linked
+
+        return sum(bool(verify_witness_linked(r, n)) for r, n in witnesses)
+
+    ok = 0
+    for root, nodes in witnesses:
+        digests = native.keccak256_batch(nodes)
+        raw = b"".join(nodes)
+        lens = np.asarray([len(n) for n in nodes], np.uint32)
+        offsets = np.zeros(len(nodes), np.uint64)
+        if len(nodes) > 1:
+            offsets[1:] = np.cumsum(lens[:-1])
+        blob = np.frombuffer(raw, np.uint8)
+        ref_off, _ref_node = native.scan_refs(blob, offsets, lens)
+        refset = {raw[o : o + 32] for o in ref_off.tolist()}
+        if root in set(digests) and all(
+            d == root or d in refset for d in digests
         ):
-            return _bench_ecrecover_inner(platform)
-    except Exception as e:
-        return {"ecrecover_error": repr(e)[:200]}
+            ok += 1
+    return ok
 
 
-def _bench_ecrecover_inner(platform: str = "tpu") -> dict:
+def _run_engine(warm, span, hasher=None, backend=None, eng_batch=None):
+    """Warm on the prefix, then time the span (verdicts are host numpy —
+    the digest readbacks inside intern() make this sync-honest). Returns
+    (span_seconds, novel_hashed, stats, engine)."""
+    from phant_tpu.backend import set_crypto_backend
+    from phant_tpu.ops.witness_engine import WitnessEngine
+
+    b = eng_batch or int(os.environ.get("PHANT_BENCH_ENGINE_BATCH", "64"))
+    if backend:
+        set_crypto_backend(backend)
     try:
+        eng = WitnessEngine(hasher=hasher)
+        for i in range(0, len(warm), b):
+            assert eng.verify_batch(warm[i : i + b]).all()
+        warm_hashed = eng.stats["hashed"]
+        t0 = time.perf_counter()
+        for i in range(0, len(span), b):
+            assert eng.verify_batch(span[i : i + b]).all()
+        dt = time.perf_counter() - t0
+        return dt, eng.stats["hashed"] - warm_hashed, dict(eng.stats), eng
+    finally:
+        if backend:
+            set_crypto_backend("cpu")
+
+
+# ---------------------------------------------------------------------------
+# sections — each returns a flat dict fragment merged into detail.
+# *_cpu sections never touch jax; *_device sections are run in a child
+# subprocess when a real accelerator is expected (parent pins itself to
+# jax-cpu, so on a CPU-only run they execute inline as the XLA-CPU path).
+# ---------------------------------------------------------------------------
+
+
+def sec_engine_cpu() -> dict:
+    warm, span = _witness_chain()
+    n_blocks = len(span)
+    node_lists = [nodes for _root, nodes in span]
+
+    verify_cpu(span[:4])  # warm the native lib
+    cpu_s = float("inf")
+    for _ in range(2):
+        t0 = time.perf_counter()
+        ok_cpu = verify_cpu(span)
+        cpu_s = min(cpu_s, time.perf_counter() - t0)
+        assert ok_cpu == n_blocks
+    cpu_rate = n_blocks / cpu_s
+
+    # engine on native C hashing (architecture-only contribution)
+    ecpu_s, novel, _st, eng = _run_engine(warm, span)
+    # fully-cached ceiling: every span node already interned -> the
+    # steady-state linkage-only rate (zero cryptography on the hot path)
+    t0 = time.perf_counter()
+    b = int(os.environ.get("PHANT_BENCH_ENGINE_BATCH", "64"))
+    for i in range(0, len(span), b):
+        assert eng.verify_batch(span[i : i + b]).all()
+    cached_s = time.perf_counter() - t0
+
+    return {
+        "cpu_baseline_blocks_per_sec": round(cpu_rate, 2),
+        "engine_cpu_blocks_per_sec": round(n_blocks / ecpu_s, 2),
+        "engine_cached_ceiling_blocks_per_sec": round(n_blocks / cached_s, 2),
+        "novel_nodes_per_block": round(novel / n_blocks, 1) if novel else None,
+        "nodes_per_block": round(sum(len(n) for n in node_lists) / n_blocks, 1),
+        "witness_bytes_per_block": round(
+            sum(len(n) for nl in node_lists for n in nl) / n_blocks
+        ),
+        "verification": "linked-multiproof-memoized",
+    }
+
+
+def sec_engine_device() -> dict:
+    import jax
+    import jax.numpy as jnp
+
+    from phant_tpu.ops.witness_engine import WitnessEngine
+    from phant_tpu.ops.witness_jax import (
+        WITNESS_MAX_CHUNKS,
+        pack_witness_fused,
+        roots_to_words,
+        witness_verify_fused,
+    )
+
+    # the parent avoids importing jax, so it carries its own copy of the
+    # chunk capacity; a retune of the kernel must fail loudly here, not
+    # silently measure a different shape than production routes
+    assert WITNESS_MAX_CHUNKS == MAX_CHUNKS, (WITNESS_MAX_CHUNKS, MAX_CHUNKS)
+    warm, span = _witness_chain()
+    n_blocks = len(span)
+    node_lists = [nodes for _root, nodes in span]
+    out: dict = {"backend": jax.devices()[0].platform}
+
+    # the product path: --crypto_backend=tpu with adaptive link-aware
+    # routing (ships a novel batch to the chip only when the measured link
+    # says it beats the native hasher)
+    edev_s, novel, rstats, _e = _run_engine(warm, span, backend="tpu")
+    out["engine_tpu_blocks_per_sec"] = round(n_blocks / edev_s, 2)
+    out["routing"] = {
+        "device_batches": rstats.get("device_batches", 0),
+        "native_batches": rstats.get("native_batches", 0),
+    }
+    _bank(out)
+    # transparency: the device FORCED on every novel batch
+    try:
+        efrc_s, _n, _s, _e2 = _run_engine(
+            warm, span, hasher=WitnessEngine._hash_batch_device, eng_batch=256
+        )
+        out["engine_tpu_forced_blocks_per_sec"] = round(n_blocks / efrc_s, 2)
+        _bank({"engine_tpu_forced_blocks_per_sec": out["engine_tpu_forced_blocks_per_sec"]})
+    except Exception as e:
+        out["engine_tpu_forced_error"] = repr(e)[:160]
+
+    # cold fused device kernel (no memoization), honest end-to-end sync
+    _, meta0 = pack_witness_fused(node_lists, MAX_CHUNKS)
+    pad_nodes = meta0.shape[1]
+    roots_d = jnp.asarray(roots_to_words([r for r, _ in span]))
+
+    def dispatch():
+        blob, meta16 = pack_witness_fused(
+            node_lists, MAX_CHUNKS, pad_nodes_to=pad_nodes
+        )
+        return witness_verify_fused(
+            jnp.asarray(blob),
+            jnp.asarray(meta16),
+            roots_d,
+            max_chunks=MAX_CHUNKS,
+            n_blocks=n_blocks,
+        )
+
+    ok0 = int(np.asarray(dispatch()).sum())  # compile + check
+    assert ok0 == n_blocks
+    cold_s = float("inf")
+    for _ in range(2):
+        t0 = time.perf_counter()
+        ok_dev = int(np.asarray(dispatch()).sum())  # forced sync
+        cold_s = min(cold_s, time.perf_counter() - t0)
+        assert ok_dev == n_blocks, f"device {ok_dev}/{n_blocks}"
+    out["device_cold_blocks_per_sec"] = round(n_blocks / cold_s, 2)
+    _bank({"device_cold_blocks_per_sec": out["device_cold_blocks_per_sec"]})
+
+    # device-RESIDENT witness bytes: upload once, repeated verify
+    # dispatches — the rate a locally-attached chip would see (upload
+    # dominates end-to-end on a tunnel). Pipelined at depth 4 to amortize
+    # the readback round trip; the final np.asarray of every verdict is
+    # the honest sync.
+    blob, meta16 = pack_witness_fused(node_lists, MAX_CHUNKS, pad_nodes_to=pad_nodes)
+    blob_d, meta_d = jnp.asarray(blob), jnp.asarray(meta16)
+    fn = lambda: witness_verify_fused(
+        blob_d, meta_d, roots_d, max_chunks=MAX_CHUNKS, n_blocks=n_blocks
+    )
+    assert int(np.asarray(fn()).sum()) == n_blocks  # warm
+    depth = 4
+    res_s = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        outs = [fn() for _ in range(depth)]
+        oks = [int(np.asarray(o).sum()) for o in outs]  # forced sync, all
+        res_s = min(res_s, time.perf_counter() - t0)
+        assert all(ok == n_blocks for ok in oks)
+    out["device_resident_blocks_per_sec"] = round(n_blocks * depth / res_s, 2)
+    out.update(_tunnel_profile())
+    return out
+
+
+def sec_state_root_cpu() -> dict:
+    """BASELINE.md metric #2, host side: recompute every node digest of a
+    mainnet-block-sized account trie (the reference recomputes roots from
+    scratch per block, src/mpt/mpt.zig:38-45 — and skips the state root
+    entirely, src/blockchain/blockchain.zig:83-85)."""
+    from phant_tpu.ops.mpt_jax import build_hash_plan, execute_plan_host
+
+    trie, expected, _n = _state_root_trie()
+    plan = build_hash_plan(trie)
+    assert plan is not None
+    assert execute_plan_host(plan) == expected  # warm native lib
+    cpu_t = []
+    for _ in range(7):
+        t0 = time.perf_counter()
+        assert execute_plan_host(plan) == expected
+        cpu_t.append(time.perf_counter() - t0)
+    cold_t = []
+    for _ in range(3):
+        trie._enc_cache.clear()
+        t0 = time.perf_counter()
+        assert trie.root_hash() == expected
+        cold_t.append(time.perf_counter() - t0)
+    return {
+        "state_root_cpu_p50_ms": round(float(np.median(cpu_t)) * 1e3, 2),
+        "state_root_cpu_coldwalk_p50_ms": round(
+            float(np.median(cold_t)) * 1e3, 2
+        ),
+        "state_root_accounts": int(
+            os.environ.get("PHANT_BENCH_SR_ACCOUNTS", "2048")
+        ),
+    }
+
+
+def _state_root_trie():
+    """Deterministic account trie for the state-root sections. Fixed-width
+    leaf values so K block-states share one plan structure (batched roots)."""
+    from phant_tpu import rlp
+    from phant_tpu.crypto.keccak import keccak256
+    from phant_tpu.mpt.mpt import Trie
+
+    rng = np.random.default_rng(11)
+    trie = Trie()
+    n_accounts = int(os.environ.get("PHANT_BENCH_SR_ACCOUNTS", "2048"))
+    for _ in range(n_accounts):
+        leaf = rlp.encode(
+            [
+                rlp.encode_uint(int(rng.integers(0, 1000))),
+                rlp.encode_uint(int(rng.integers(0, 10**18))),
+                rng.bytes(32),
+                rng.bytes(32),
+            ]
+        )
+        trie.put(keccak256(rng.bytes(20)), leaf)
+    return trie, trie.root_hash(), n_accounts
+
+
+def sec_state_root_device() -> dict:
+    """Device state root: single fused dispatch p50, PLUS the K-roots-per-
+    dispatch batched variant that amortizes the tunnel round trip across a
+    span of blocks (VERDICT r3 #4), PLUS the explicit routing verdict the
+    production gate (backend.device_offload_pays) would make for this
+    shape on the measured link."""
+    from phant_tpu.backend import device_offload_pays, device_link_profile
+    from phant_tpu.ops.mpt_jax import (
+        build_hash_plan,
+        execute_plan_host,
+        trie_root_device,
+        trie_roots_device_batched,
+    )
+
+    trie, expected, n_accounts = _state_root_trie()
+    plan = build_hash_plan(trie)
+    assert plan is not None
+    out: dict = {}
+
+    trie_root_device(trie, plan)  # compile + device-residency
+    dev_t = []
+    for _ in range(7):
+        t0 = time.perf_counter()
+        assert trie_root_device(trie, plan) == expected
+        dev_t.append(time.perf_counter() - t0)
+    out["state_root_tpu_p50_ms"] = round(float(np.median(dev_t)) * 1e3, 2)
+    _bank({"state_root_tpu_p50_ms": out["state_root_tpu_p50_ms"]})
+
+    # K block-states in one dispatch: same structure, K value-mutated blobs
+    # (the production replay shape — consecutive blocks differ only in the
+    # leaves they wrote). Each blob is a full independent root recompute.
+    K = int(os.environ.get("PHANT_BENCH_SR_BATCH", "16"))
+    import copy
+
+    plans = []
+    expecteds = []
+    rng = np.random.default_rng(13)
+    leaf_off, _ln, _hp, _hc = plan.levels[0]
+    for k in range(K):
+        p = copy.copy(plan)
+        p.blob = plan.blob.copy()
+        p.device_args = None
+        # mutate 8 leaf values in place (balance-field bytes inside the
+        # leaf template) — fixed-width values keep the layout identical
+        for i in rng.integers(0, len(leaf_off), size=8):
+            off = int(leaf_off[int(i)])
+            p.blob[off + 40 : off + 48] = np.frombuffer(rng.bytes(8), np.uint8)
+        plans.append(p)
+        expecteds.append(execute_plan_host(p))
+    got = trie_roots_device_batched(plans)  # compile + correctness
+    assert got == expecteds, "batched device roots mismatch host"
+    bat_t = []
+    for _ in range(5):
+        t0 = time.perf_counter()
+        got = trie_roots_device_batched(plans)
+        bat_t.append(time.perf_counter() - t0)
+        assert got == expecteds
+    per_root_ms = float(np.median(bat_t)) * 1e3 / K
+    out["state_root_tpu_batched_per_root_ms"] = round(per_root_ms, 2)
+    out["state_root_tpu_batch"] = K
+
+    # the production routing verdict for this exact shape on this link
+    nbytes = int(plan.blob.size)
+    up_bps, rtt = device_link_profile()
+    out["state_root_routing"] = (
+        "device"
+        if device_offload_pays(nbytes)
+        else f"native (link {up_bps / 1e6:.0f}MB/s, rtt {rtt * 1e3:.0f}ms, "
+        f"{nbytes}B/root)"
+    )
+    return out
+
+
+def sec_keccak_cpu() -> dict:
+    from phant_tpu.utils.native import load_native
+
+    rng = np.random.default_rng(17)
+    N = int(os.environ.get("PHANT_BENCH_KECCAK_N", "16384"))
+    payloads = [rng.bytes(int(rng.integers(32, 577))) for _ in range(N)]
+    native = load_native()
+    if native is not None:
+        native.keccak256_batch(payloads)  # warm
+        cpu_s = float("inf")
+        for _ in range(5):
+            t0 = time.perf_counter()
+            native.keccak256_batch(payloads)
+            cpu_s = min(cpu_s, time.perf_counter() - t0)
+    else:
         from phant_tpu.crypto.keccak import keccak256
-        from phant_tpu.crypto import secp256k1 as cpu_secp
-        from phant_tpu.ops.secp256k1_jax import ecrecover_batch
-        from phant_tpu.utils.native import load_native
 
-        rng = np.random.default_rng(3)
-        # a prefetch-window-sized signature batch (chain.run_blocks
-        # concatenates blocks to this scale); CPU fallback keeps the
-        # cache-warm batch-32 program
-        B = int(os.environ.get("PHANT_BENCH_ECRECOVER_B", "1024")) if platform != "cpu" else 32
-        keys = [int.from_bytes(rng.bytes(32), "big") % cpu_secp.N or 1 for _ in range(B)]
-        msgs = [keccak256(rng.bytes(64)) for _ in range(B)]
-        sigs = [cpu_secp.sign(m, k) for m, k in zip(msgs, keys)]
-        rs = [s[0] for s in sigs]
-        ss = [s[1] for s in sigs]
-        recids = [s[2] for s in sigs]
+        t0 = time.perf_counter()
+        for p in payloads:
+            keccak256(p)
+        cpu_s = time.perf_counter() - t0
+    return {
+        "keccak_cpu_hashes_per_sec": round(N / cpu_s, 1),
+        "keccak_batch": N,
+    }
 
-        # CPU baseline: the fused native batch (the honest baseline — it is
-        # what the cpu crypto backend actually runs). Warm + best-of-N at
-        # the SAME batch size as the device (round-2 weak #6 symmetry fix).
-        reps = 5
-        native = load_native()
-        if native is not None:
-            native_out = native.ecrecover_batch(msgs, rs, ss, recids)  # warm
-            assert all(a is not None for a in native_out)
-            cpu_s = float("inf")
-            for _ in range(reps):
+
+def sec_keccak_device() -> dict:
+    """BASELINE.md config #2 on device: end-to-end (host pack -> transfer
+    -> hash -> readback) and device-resident rates, diffed against the
+    native digests."""
+    import jax.numpy as jnp
+
+    from phant_tpu.crypto.keccak import keccak256
+    from phant_tpu.ops.keccak_jax import (
+        digests_to_bytes,
+        keccak256_chunked,
+        pack_payloads,
+    )
+    from phant_tpu.utils.native import load_native
+
+    rng = np.random.default_rng(17)
+    N = int(os.environ.get("PHANT_BENCH_KECCAK_N", "16384"))
+    payloads = [rng.bytes(int(rng.integers(32, 577))) for _ in range(N)]
+    native = load_native()
+    want = (
+        native.keccak256_batch(payloads)
+        if native is not None
+        else [keccak256(p) for p in payloads]
+    )
+
+    def run():
+        words, nchunks, _C = pack_payloads(payloads, 5)
+        out = keccak256_chunked(
+            jnp.asarray(words), jnp.asarray(nchunks), max_chunks=5
+        )
+        return digests_to_bytes(np.asarray(out))
+
+    got = run()  # compile + warm
+    assert got == want, "device keccak mismatch vs native"
+    dev_s = float("inf")
+    for _ in range(5):
+        t0 = time.perf_counter()
+        run()
+        dev_s = min(dev_s, time.perf_counter() - t0)
+
+    # compute-only rate with the payloads already resident in HBM (what a
+    # locally attached chip sees, where upload is ~free)
+    words, nchunks, _C = pack_payloads(payloads, 5)
+    wd, nd = jnp.asarray(words), jnp.asarray(nchunks)
+    np.asarray(keccak256_chunked(wd, nd, max_chunks=5))  # warm
+    res_s = float("inf")
+    for _ in range(5):
+        t0 = time.perf_counter()
+        np.asarray(keccak256_chunked(wd, nd, max_chunks=5))
+        res_s = min(res_s, time.perf_counter() - t0)
+    return {
+        "keccak_hashes_per_sec": round(N / dev_s, 1),
+        "keccak_device_resident_hashes_per_sec": round(N / res_s, 1),
+        "keccak_batch": N,
+    }
+
+
+def _ecrecover_dataset(B: int):
+    from phant_tpu.crypto import secp256k1 as cpu_secp
+    from phant_tpu.crypto.keccak import keccak256
+
+    rng = np.random.default_rng(3)
+    keys = [int.from_bytes(rng.bytes(32), "big") % cpu_secp.N or 1 for _ in range(B)]
+    msgs = [keccak256(rng.bytes(64)) for _ in range(B)]
+    sigs = [cpu_secp.sign(m, k) for m, k in zip(msgs, keys)]
+    expected = [keccak256(cpu_secp.pubkey_of(k)[1:])[12:] for k in keys]
+    return msgs, [s[0] for s in sigs], [s[1] for s in sigs], [s[2] for s in sigs], expected
+
+
+def _ecrecover_B(platform_is_device: bool) -> int:
+    if platform_is_device:
+        return int(os.environ.get("PHANT_BENCH_ECRECOVER_B", "1024"))
+    return 32  # cache-warm small program on the XLA-CPU fallback
+
+
+def sec_ecrecover_cpu() -> dict:
+    """Config #4 baseline: the fused native batch at the SAME batch size
+    as the device (symmetry), reference scope src/crypto/ecdsa.zig:19-26."""
+    from phant_tpu.crypto import secp256k1 as cpu_secp
+    from phant_tpu.utils.native import load_native
+
+    B = _ecrecover_B(os.environ.get("PHANT_BENCH_DEVICE", "0") == "1")
+    msgs, rs, ss, recids, _expected = _ecrecover_dataset(B)
+    native = load_native()
+    if native is not None:
+        native_out = native.ecrecover_batch(msgs, rs, ss, recids)  # warm
+        assert all(a is not None for a in native_out)
+        cpu_s = float("inf")
+        for _ in range(5):
+            t0 = time.perf_counter()
+            native.ecrecover_batch(msgs, rs, ss, recids)
+            cpu_s = min(cpu_s, time.perf_counter() - t0)
+        cpu_rate = B / cpu_s
+    else:
+        sample = 8
+        t0 = time.perf_counter()
+        for i in range(sample):
+            cpu_secp.recover_pubkey(msgs[i], rs[i], ss[i], recids[i])
+        cpu_rate = sample / (time.perf_counter() - t0)
+    return {"ecrecover_cpu_baseline_per_sec": round(cpu_rate, 1)}
+
+
+def sec_ecrecover_device() -> dict:
+    """Config #4 on device: the GLV half-width four-scalar ladder
+    (ops/secp256k1_jax.py:464-, behind PHANT_ECRECOVER_KERNEL) at the
+    prefetch-window batch size, with the Shamir ladder as comparison."""
+    from phant_tpu.ops.secp256k1_jax import ecrecover_batch
+
+    B = _ecrecover_B(os.environ.get("PHANT_BENCH_DEVICE", "0") == "1")
+    msgs, rs, ss, recids, expected = _ecrecover_dataset(B)
+    out: dict = {"ecrecover_batch": B}
+
+    # compare both ladders on a real device; on the XLA-CPU fallback each
+    # extra kernel is minutes of compile for a non-number, so run only the
+    # selected one there
+    both = (
+        os.environ.get("PHANT_BENCH_ECRECOVER_BOTH", "1") == "1"
+        and os.environ.get("PHANT_BENCH_DEVICE", "0") == "1"
+    )
+    kernels = (
+        ("glv", "shamir")
+        if both
+        else (os.environ.get("PHANT_ECRECOVER_KERNEL", "glv"),)
+    )
+    best = None
+    for kern in kernels:
+        os.environ["PHANT_ECRECOVER_KERNEL"] = kern
+        try:
+            got = ecrecover_batch(msgs, rs, ss, recids)  # compile + check
+            assert got == expected, f"device ecrecover ({kern}) mismatch"
+            dev_s = float("inf")
+            for _ in range(5):
                 t0 = time.perf_counter()
-                native.ecrecover_batch(msgs, rs, ss, recids)
-                cpu_s = min(cpu_s, time.perf_counter() - t0)
-            cpu_rate = B / cpu_s
-        else:
-            sample = 8
-            t0 = time.perf_counter()
-            for i in range(sample):
-                cpu_secp.recover_pubkey(msgs[i], rs[i], ss[i], recids[i])
-            cpu_rate = sample / (time.perf_counter() - t0)
+                ecrecover_batch(msgs, rs, ss, recids)
+                dev_s = min(dev_s, time.perf_counter() - t0)
+            rate = B / dev_s
+            out[f"ecrecover_{kern}_per_sec"] = round(rate, 1)
+            _bank({f"ecrecover_{kern}_per_sec": out[f"ecrecover_{kern}_per_sec"],
+                   "ecrecover_batch": B})
+            if best is None or rate > best:
+                best = rate
+        except Exception as e:
+            out[f"ecrecover_{kern}_error"] = repr(e)[:160]
+    if best is not None:
+        out["ecrecover_per_sec"] = round(best, 1)
+    return out
 
-        out = ecrecover_batch(msgs, rs, ss, recids)  # compile + correctness
-        expected = [keccak256(cpu_secp.pubkey_of(k)[1:])[12:] for k in keys]
-        assert out == expected, "device ecrecover mismatch vs CPU"
-        dev_s = float("inf")
-        for _ in range(reps):
+
+def _replay(backend: str, verify_root: bool) -> dict:
+    """One replay variant as its own budgeted measurement (VERDICT r3 #2:
+    four variants inside one watchdog could never fit; each now emits its
+    own partial result)."""
+    from phant_tpu.backend import set_crypto_backend, set_evm_backend
+    from phant_tpu.blockchain.chain import Blockchain
+    from phant_tpu.evm.native_vm import native_available
+    from phant_tpu.state.statedb import StateDB
+
+    genesis, blocks, genesis_accounts, total_txs, n_calls = _replay_chain()
+    n_blocks = len(blocks)
+    if native_available():
+        set_evm_backend("native")
+    set_crypto_backend(backend)
+    try:
+        chain = Blockchain(
+            1,
+            StateDB({a: acct.copy() for a, acct in genesis_accounts.items()}),
+            genesis,
+            verify_state_root=verify_root,
+        )
+        t0 = time.perf_counter()
+        # run_blocks pipelines device sender recovery across blocks on the
+        # tpu backend and is a plain loop on cpu
+        chain.run_blocks(blocks)
+        dt = time.perf_counter() - t0
+    finally:
+        set_crypto_backend("cpu")
+        set_evm_backend("python")
+    key = f"replay_{'stateroot_' if verify_root else ''}{backend}_blocks_per_sec"
+    return {
+        key: round(n_blocks / dt, 1),
+        "replay_blocks": n_blocks,
+        "replay_txs_per_block": total_txs,
+        "replay_contract_calls_per_block": n_calls,
+    }
+
+
+def _bank(frag: dict) -> None:
+    """Make a finished measurement durable immediately: into _PARTIAL in
+    the parent (the global deadline prints it), onto stdout as a fragment
+    line in a device child (the parent merges EVERY fragment line, so a
+    later SIGKILL costs only the unfinished work — r3 #2's fix)."""
+    _PARTIAL["detail"].update(frag)
+    if _IS_CHILD:
+        print(_FRAGMENT_MARK + json.dumps(frag), flush=True)
+
+
+def _replay_variants(backend: str) -> dict:
+    """Both replay variants, each banked the moment it finishes (r3 #2: one
+    shared budget lost BOTH numbers when the second variant timed out)."""
+    out: dict = {}
+    for verify_root in (False, True):
+        frag = _replay(backend, verify_root)
+        out.update(frag)
+        _bank(frag)
+    return out
+
+
+def sec_replay_cpu() -> dict:
+    return _replay_variants("cpu")
+
+
+def sec_replay_device() -> dict:
+    return _replay_variants("tpu")
+
+
+# priority order matters: when the tunnel window is short, the headline
+# engine number and the GLV proof come first
+_CPU_SECTIONS = {
+    "engine": sec_engine_cpu,
+    "replay": sec_replay_cpu,
+    "state_root": sec_state_root_cpu,
+    "ecrecover": sec_ecrecover_cpu,
+    "keccak": sec_keccak_cpu,
+}
+_DEVICE_SECTIONS = {
+    "engine": sec_engine_device,
+    "ecrecover": sec_ecrecover_device,
+    "replay": sec_replay_device,
+    "state_root": sec_state_root_device,
+    "keccak": sec_keccak_device,
+}
+# per-section child budgets (seconds); cold device compiles dominate
+_DEVICE_BUDGET = {
+    "engine": 700,
+    "ecrecover": 900,
+    "replay": 700,
+    "state_root": 480,
+    "keccak": 360,
+}
+_FRAGMENT_MARK = "@@BENCH_FRAGMENT@@ "
+_IS_CHILD = False
+
+
+def _child_main(name: str) -> None:
+    """Child-process entry: run ONE device section against whatever jax
+    platform the environment provides, print the fragment, exit. A hang
+    here is killed by the parent without poisoning anything else."""
+    global _IS_CHILD
+
+    _IS_CHILD = True
+    from phant_tpu.utils.jaxcache import enable_compile_cache
+
+    enable_compile_cache()
+    try:
+        frag = _DEVICE_SECTIONS[name]()
+    except Exception as e:
+        frag = {f"{name}_device_error": repr(e)[:240]}
+    print(_FRAGMENT_MARK + json.dumps(frag), flush=True)
+
+
+def _spawn_section(name: str, timeout_s: float, device_env: dict) -> dict:
+    """Run one device section in a killable child; returns its fragment."""
+    t0 = time.perf_counter()
+    try:
+        proc = subprocess.Popen(
+            [sys.executable, os.path.abspath(__file__), "--section", name],
+            stdout=subprocess.PIPE,
+            stderr=subprocess.PIPE,
+            text=True,
+            env=device_env,
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+        )
+        _CHILDREN.append(proc)
+        killed = False
+        try:
+            out, err = proc.communicate(timeout=timeout_s)
+        except subprocess.TimeoutExpired:
+            killed = True
+            proc.kill()
+            out, err = proc.communicate()
+        finally:
+            _CHILDREN.remove(proc)
+        # merge EVERY fragment line in order: sections bank intermediate
+        # measurements (e.g. each replay variant) as they finish, so a kill
+        # or crash costs only the unfinished work
+        frag: dict = {}
+        for line in (out or "").splitlines():
+            if line.startswith(_FRAGMENT_MARK):
+                try:
+                    frag.update(json.loads(line[len(_FRAGMENT_MARK) :]))
+                except json.JSONDecodeError:
+                    pass  # a torn final line from the kill
+        if killed:
+            frag[f"{name}_device_error"] = f"child killed after {timeout_s:.0f}s"
+        elif not frag:
+            frag[f"{name}_device_error"] = (
+                f"no fragment (rc={proc.returncode}): " + ((err or out) or "")[-240:]
+            )
+        frag[f"{name}_device_seconds"] = round(time.perf_counter() - t0, 1)
+        return frag
+    except Exception as e:
+        return {f"{name}_device_error": repr(e)[:240]}
+
+
+def _probe_device(device_env: dict, timeout_s: float) -> tuple:
+    """(ok, err) — one throwaway-subprocess liveness check with a real
+    compute + forced readback."""
+    try:
+        probe = subprocess.run(
+            [
+                sys.executable,
+                "-c",
+                "import jax, numpy as np, jax.numpy as jnp; d = jax.devices(); "
+                "x = jnp.ones((64, 64)); r = np.asarray(x @ x); "
+                "print(d[0].platform, r[0, 0])",
+            ],
+            capture_output=True,
+            text=True,
+            timeout=timeout_s,
+            env=device_env,
+        )
+        if probe.returncode == 0 and probe.stdout.strip():
+            plat = probe.stdout.strip().splitlines()[-1].split()[0]
+            if plat != "cpu":
+                return True, None
+            return False, "probe returned cpu despite TPU env"
+        return False, (probe.stderr or "empty probe output")[-240:]
+    except subprocess.TimeoutExpired:
+        return False, f"probe timed out after {timeout_s:.0f}s"
+
+
+def main() -> None:
+    import faulthandler
+    import signal as _signal
+
+    # kill -USR1 <pid> dumps all python stacks to stderr — the one-line
+    # debugger for "which call is stuck on the dead tunnel"
+    faulthandler.register(_signal.SIGUSR1)
+    t_start = time.perf_counter()
+    global_budget = float(os.environ.get("PHANT_BENCH_GLOBAL_TIMEOUT", "2400"))
+    _arm_global_deadline()
+    detail = _PARTIAL["detail"]
+
+    only = os.environ.get("PHANT_BENCH_ONLY", "")
+    selected = [s.strip() for s in only.split(",") if s.strip()] or list(
+        _CPU_SECTIONS
+    )
+    # legacy per-section kill switches stay honored
+    for flag, sec in (
+        ("PHANT_BENCH_STATE_ROOT", "state_root"),
+        ("PHANT_BENCH_REPLAY", "replay"),
+        ("PHANT_BENCH_KECCAK", "keccak"),
+        ("PHANT_BENCH_ECRECOVER", "ecrecover"),
+    ):
+        if os.environ.get(flag, "1") in ("0", "") and sec in selected:
+            selected.remove(sec)
+
+    # the child env keeps the real device platform; the parent pins itself
+    # to jax-cpu so no accidental import can touch the tunnel
+    device_env = dict(os.environ)
+    env_platforms = os.environ.get("JAX_PLATFORMS", "")
+    tpu_expected = any(p in env_platforms for p in ("axon", "tpu")) or bool(
+        os.environ.get("PALLAS_AXON_POOL_IPS")
+    )
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+    # CPU baselines must run at the same batch sizes the device run uses
+    # (r2 asymmetry lesson): a device-bound run sizes both sides big
+    os.environ["PHANT_BENCH_DEVICE"] = "1" if tpu_expected else "0"
+
+    probe_attempts: list = []
+    detail["tpu_probe_attempts"] = probe_attempts
+
+    def probe(timeout_s: float) -> bool:
+        ok, err = _probe_device(device_env, timeout_s)
+        probe_attempts.append(
+            {
+                "t_s": round(time.perf_counter() - t_start, 1),
+                "ok": ok,
+                **({"err": err[-120:]} if err else {}),
+            }
+        )
+        return ok
+
+    def remaining() -> float:
+        return global_budget - (time.perf_counter() - t_start)
+
+    alive = False
+    n_initial = int(os.environ.get("PHANT_BENCH_PROBE_RETRIES", "2"))
+    probe_timeout = float(os.environ.get("PHANT_BENCH_PROBE_TIMEOUT", "90"))
+    if tpu_expected and n_initial <= 0:
+        # probing disabled outright: run as a CPU bench (the contract-test
+        # escape hatch), but keep the annotation loud
+        tpu_expected = False
+        detail["tpu_expected_but_absent"] = (
+            f"TPU env present ({env_platforms!r}) but probing disabled "
+            "(PHANT_BENCH_PROBE_RETRIES=0)"
+        )
+    if tpu_expected:
+        for _ in range(n_initial):
+            _log(f"probing device (timeout {probe_timeout:.0f}s) ...")
+            if probe(probe_timeout):
+                alive = True
+                break
+        _log(f"device {'ALIVE' if alive else 'unreachable'} after initial probes")
+
+    # datasets first (outside any watchdog; disk-cached for repeat runs)
+    _log("building datasets ...")
+    t0 = time.perf_counter()
+    if "engine" in selected:
+        _witness_chain()
+    if "replay" in selected:
+        try:
+            _replay_chain()
+        except Exception as e:
+            detail["replay_error"] = f"chain build: {repr(e)[:200]}"
+            selected.remove("replay")
+    detail["dataset_build_seconds"] = round(time.perf_counter() - t0, 1)
+
+    run_device_inline = not tpu_expected  # CPU-only run: XLA-CPU inline
+    device_done: set = set()
+
+    def run_device_sections() -> None:
+        """Device sections in priority order, each in a killable child."""
+        for name in _DEVICE_SECTIONS:
+            if name not in selected or name in device_done:
+                continue
+            if name == "ecrecover" and os.environ.get(
+                "PHANT_BENCH_ECRECOVER", "1"
+            ) in ("0", ""):
+                continue
+            budget = min(
+                float(
+                    os.environ.get(
+                        f"PHANT_BENCH_SEC_{name.upper()}_TIMEOUT",
+                        _DEVICE_BUDGET[name],
+                    )
+                ),
+                remaining() - 90,  # leave room for the final print
+            )
+            if budget < 60:
+                detail[f"{name}_device_error"] = "global budget exhausted"
+                continue
+            device_env["PHANT_BENCH_DEVICE"] = "1"
+            frag = _spawn_section(name, budget, device_env)
+            detail.update(frag)
+            device_done.add(name)
+
+    def run_cpu_sections() -> None:
+        for name, fn in _CPU_SECTIONS.items():
+            if name not in selected:
+                continue
+            _log(f"cpu section {name} ...")
             t0 = time.perf_counter()
-            ecrecover_batch(msgs, rs, ss, recids)
-            dev_s = min(dev_s, time.perf_counter() - t0)
-        dev_rate = B / dev_s
-        return {
-            "ecrecover_per_sec": round(dev_rate, 1),
-            "ecrecover_cpu_baseline_per_sec": round(cpu_rate, 1),
-            "ecrecover_batch": B,
-        }
-    except Exception as e:  # never let the secondary metric sink the bench
-        return {"ecrecover_error": repr(e)[:200]}
+            try:
+                with _watchdog(
+                    int(os.environ.get("PHANT_BENCH_SECTION_TIMEOUT", "480"))
+                ):
+                    detail.update(fn())
+            except Exception as e:
+                detail[f"{name}_cpu_error"] = repr(e)[:200]
+            _log(f"cpu section {name} done in {time.perf_counter() - t0:.1f}s")
+            _refresh_headline()
+
+    def run_device_inline_sections() -> None:
+        """CPU-only runs execute the device sections inline as the XLA-CPU
+        path (the r1-r3 contract: keccak/replay-tpu keys exist on every
+        artifact). engine/state_root device variants are skipped — minutes
+        of XLA-CPU compile for a non-number (r3 lesson)."""
+        os.environ["PHANT_BENCH_DEVICE"] = "0"
+        _pin_jax_cpu()
+        for name in ("replay", "keccak"):
+            if name not in selected:
+                continue
+            if name == "keccak" and os.environ.get("PHANT_BENCH_KECCAK", "1") in ("0", ""):
+                continue
+            _log(f"inline device section {name} ...")
+            t0 = time.perf_counter()
+            try:
+                with _watchdog():
+                    detail.update(_DEVICE_SECTIONS[name]())
+            except Exception as e:
+                detail[f"{name}_device_error"] = repr(e)[:200]
+            _log(f"inline device section {name} done in {time.perf_counter() - t0:.1f}s")
+        if "ecrecover" in selected and os.environ.get(
+            "PHANT_BENCH_ECRECOVER", "1"
+        ) not in ("0", ""):
+            try:
+                with _watchdog(
+                    int(os.environ.get("PHANT_BENCH_ECRECOVER_TIMEOUT", "900"))
+                ):
+                    detail.update(sec_ecrecover_device())
+            except Exception as e:
+                detail["ecrecover_device_error"] = repr(e)[:200]
+
+    def _refresh_headline() -> None:
+        cpu_rate = detail.get("cpu_baseline_blocks_per_sec")
+        dev = detail.get("engine_tpu_blocks_per_sec") or detail.get(
+            "engine_cpu_blocks_per_sec"
+        )
+        if dev:
+            _PARTIAL["value"] = dev
+            if cpu_rate:
+                _PARTIAL["vs_baseline"] = round(dev / cpu_rate, 2)
+
+    # --- orchestration: device first when alive; otherwise CPU first then
+    # retry the probe for the remainder of the window -----------------------
+    if alive:
+        run_device_sections()
+        run_cpu_sections()
+    else:
+        run_cpu_sections()
+        if run_device_inline:
+            run_device_inline_sections()
+    if tpu_expected and not alive:
+        retry_sleep = float(os.environ.get("PHANT_BENCH_PROBE_RETRY_SLEEP", "60"))
+        while remaining() > 300 and not alive:
+            time.sleep(min(retry_sleep, max(remaining() - 240, 1)))
+            _log(
+                f"late probe retry ({remaining():.0f}s of global budget left)"
+            )
+            if probe(min(probe_timeout, remaining() - 180)):
+                alive = True
+                _log("tunnel revived — running device sections")
+                run_device_sections()
+        if not alive:
+            last_err = probe_attempts[-1].get("err") if probe_attempts else "unprobed"
+            msg = f"TPU expected ({env_platforms!r}) but unreachable: {last_err}"
+            if os.environ.get("PHANT_BENCH_REQUIRE_TPU"):
+                print(f"[bench] FATAL: {msg}", file=sys.stderr)
+                sys.exit(2)
+            detail["tpu_expected_but_absent"] = msg
+
+    detail.setdefault("backend", "cpu")  # children set the real platform
+    detail["timing"] = "forced-readback"
+    _refresh_headline()
+    _emit_final()
 
 
 if __name__ == "__main__":
-    main()
+    if len(sys.argv) >= 3 and sys.argv[1] == "--section":
+        _child_main(sys.argv[2])
+    else:
+        main()

@@ -59,6 +59,8 @@ def jax_device_ok() -> bool:
 
 
 _LINK_PROFILE: tuple | None = None
+_LINK_FAIL_UNTIL: float | None = None  # monotonic deadline of the backoff
+_LINK_FAIL_TTL_S = 60.0
 
 
 def device_link_profile() -> tuple:
@@ -69,11 +71,14 @@ def device_link_profile() -> tuple:
     can be ~20 MB/s with ~50ms round trips — three orders of magnitude that
     flip which batch sizes are worth shipping. Probing costs ~0.3s once.
     Overridable for tests/ops via PHANT_LINK_MBPS / PHANT_LINK_RTT_MS."""
-    global _LINK_PROFILE
+    global _LINK_PROFILE, _LINK_FAIL_UNTIL
     import os
+    import time as _time
 
     if _LINK_PROFILE is not None:
         return _LINK_PROFILE
+    if _LINK_FAIL_UNTIL is not None and _time.monotonic() < _LINK_FAIL_UNTIL:
+        return (1.0, 3600.0)  # recent probe failure: don't re-pay it yet
     mbps = os.environ.get("PHANT_LINK_MBPS")
     rtt = os.environ.get("PHANT_LINK_RTT_MS")
     if mbps and rtt:
@@ -87,19 +92,35 @@ def device_link_profile() -> tuple:
 
         tiny = jnp.zeros((8,), jnp.uint32)
         int(jnp.sum(tiny))  # warm dispatch path
-        t0 = time.perf_counter()
-        int(jnp.sum(tiny))
-        lat = time.perf_counter() - t0
+        # best-of-3 samples: a single scheduler hiccup must not skew
+        # routing for the whole process lifetime
+        lat = min(
+            _timed(lambda: int(jnp.sum(tiny)), time) for _ in range(3)
+        )
         # random payload: a compressing transport must not flatter the probe
         x = np.random.default_rng(0).integers(0, 256, size=1 << 20).astype(np.uint8)
         int(jnp.sum(jnp.asarray(x)[:8]))  # warm transfer path
-        t0 = time.perf_counter()
-        int(jnp.sum(jnp.asarray(x)[:8]))
-        up = max(time.perf_counter() - t0 - lat, 1e-9)
+        up = min(
+            _timed(lambda: int(jnp.sum(jnp.asarray(x)[:8])), time)
+            for _ in range(2)
+        )
+        up = max(up - lat, 1e-9)
         _LINK_PROFILE = (len(x) / up, lat)
     except Exception:
-        _LINK_PROFILE = (1.0, 3600.0)  # unusable link
+        # probe failure: report an unusable link and back off for a TTL —
+        # neither extreme is right (r2 pinned never-offload for the whole
+        # process on one hiccup; an uncached failure would re-pay a
+        # seconds-long dead-tunnel probe on EVERY novel batch of the hot
+        # verification path during an outage)
+        _LINK_FAIL_UNTIL = _time.monotonic() + _LINK_FAIL_TTL_S
+        return (1.0, 3600.0)
     return _LINK_PROFILE
+
+
+def _timed(fn, time_mod) -> float:
+    t0 = time_mod.perf_counter()
+    fn()
+    return time_mod.perf_counter() - t0
 
 
 # conservative throughput constants for the adaptive offload cost model

@@ -239,8 +239,7 @@ def execute_plan_host(plan: HashPlan) -> bytes:
     return digests[plan.root_pos].tobytes()
 
 
-@functools.partial(jax.jit, static_argnames=("max_chunks",))
-def _hash_plan_fused(blob, levels, *, max_chunks: int):
+def _hash_plan_body(blob, levels, *, max_chunks: int):
     """Execute a whole HashPlan in ONE device program: for each level
     (statically unrolled; shapes are the jit cache key) scatter the child
     digests into the template holes, hash the level with the batched keccak
@@ -249,7 +248,9 @@ def _hash_plan_fused(blob, levels, *, max_chunks: int):
     is the difference between ~1x and ~{levels}x RTT per root.
 
     Returns the (8,) u32 root digest words (the root is the unique
-    max-level node, laid out last by build_hash_plan)."""
+    max-level node, laid out last by build_hash_plan). Unjitted body so
+    `_hash_plans_batched` can vmap it over a batch of blobs; the scalar
+    entry point `_hash_plan_fused` wraps it in jit."""
     total_pad = sum(off.shape[0] for off, _l, _p, _c in levels)
     digests = jnp.zeros((total_pad, 8), jnp.uint32)
     shifts = jnp.arange(4, dtype=jnp.uint32) * 8
@@ -266,6 +267,52 @@ def _hash_plan_fused(blob, levels, *, max_chunks: int):
         )
         out_start += off.shape[0]
     return digests[-1]
+
+
+_hash_plan_fused = functools.partial(jax.jit, static_argnames=("max_chunks",))(
+    _hash_plan_body
+)
+
+
+@functools.partial(jax.jit, static_argnames=("max_chunks",))
+def _hash_plans_batched(blobs, levels, *, max_chunks: int):
+    """K state roots in ONE dispatch: vmap the fused plan executor over a
+    (K, L) batch of template blobs sharing one level layout. This is the
+    production shape for block replay — K consecutive block states of the
+    same account trie differ only in leaf *values*, so the structural plan
+    (offsets/holes) is shared and only the blobs vary. Amortizes the
+    host->device round trip over K roots (the per-root RTT is what the
+    offload gate rejects at K=1 on a tunneled link)."""
+    return jax.vmap(
+        lambda b: _hash_plan_body(b, levels, max_chunks=max_chunks)
+    )(blobs)
+
+
+def trie_roots_device_batched(plans: List[HashPlan]) -> List[bytes]:
+    """Roots for K same-structure plans (identical level layouts, differing
+    blobs) in one fused device dispatch. Raises ValueError if the plans'
+    layouts differ (callers batch consecutive block states, which share
+    structure by construction when leaf values are fixed-width)."""
+    if not plans:
+        return []
+    ref = plans[0]
+    for p in plans[1:]:
+        if len(p.blob) != len(ref.blob) or len(p.levels) != len(ref.levels):
+            raise ValueError("batched plans must share structure")
+        for (o1, l1, h1, c1), (o2, l2, h2, c2) in zip(p.levels, ref.levels):
+            if (
+                o1.shape != o2.shape
+                or not np.array_equal(o1, o2)
+                or not np.array_equal(l1, l2)
+                or not np.array_equal(h1, h2)
+                or not np.array_equal(c1, c2)
+            ):
+                raise ValueError("batched plans must share structure")
+    blobs = jnp.asarray(np.stack([p.blob for p in plans]))
+    levels_d = tuple(tuple(jnp.asarray(a) for a in lvl) for lvl in ref.levels)
+    roots = _hash_plans_batched(blobs, levels_d, max_chunks=MPT_MAX_CHUNKS)
+    arr = np.asarray(roots, dtype="<u4")
+    return [arr[k].tobytes() for k in range(arr.shape[0])]
 
 
 def trie_root_device(trie: Trie, plan: Optional[HashPlan] = None) -> bytes:

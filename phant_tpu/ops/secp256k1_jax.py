@@ -526,10 +526,21 @@ def pack_glv_inputs(
 ) -> Tuple[np.ndarray, np.ndarray]:
     """(mags (B,4,9) u32, signs (B,4) u32) for `ecrecover_kernel_glv`: the
     host-bigint half of recovery — r^-1 mod n, u1/u2, and the lambda
-    decomposition of each. Callers must have screened r, s into (0, N).
-    The single packing recipe shared by the dispatch path, the driver
-    dryrun, and the differential tests."""
+    decomposition of each. The single packing recipe shared by the dispatch
+    path, the driver dryrun, and the differential tests.
+
+    PRECONDITION (validated here): r, s in (0, N). The device kernel checks
+    r's range itself but trusts s entirely — an out-of-range s would pack a
+    garbage lambda split and recover to a wrong-but-plausible address, so
+    it is rejected at the boundary (_dispatch_glv pre-screens and never
+    passes one; direct callers hit this raise)."""
     B = len(msg_hashes)
+    for i in range(B):
+        if not (0 < rs[i] < N and 0 < ss[i] < N):
+            raise ValueError(
+                f"signature {i}: r,s must be pre-screened into (0,N) "
+                "(ecrecover_kernel_glv trusts the packed split)"
+            )
     mags = np.zeros((B, 4, _GLV_LIMBS), np.uint32)
     signs = np.zeros((B, 4), np.uint32)
     for i in range(B):
@@ -602,6 +613,11 @@ def ecrecover_kernel_glv(r, parity, mags, signs):
 
     Returns (digest_words, valid, degenerate); `degenerate` elements carry
     garbage and must be replayed on the exact CPU path.
+
+    PRECONDITION: mags/signs must come from `pack_glv_inputs` (or an
+    equivalent that screened 0 < s < N). The kernel validates r's range and
+    curve membership on-device but cannot see s — `valid` does NOT cover an
+    out-of-range s, whose split packs to garbage.
     """
     from phant_tpu.ops.keccak_jax import keccak256_chunked
 

@@ -125,7 +125,16 @@ class WitnessEngine:
         # backend check FIRST: the adaptive gate probes the device link,
         # which must never happen on the pure-CPU path (a dead tunnel would
         # hang a run that never asked for a device)
-        if crypto_backend() == "tpu" and jax_device_ok() and (
+        from phant_tpu.crypto.keccak import RATE
+
+        # nodes at/over the kernel's absorb capacity (pad byte positions
+        # would fall past the gathered chunks) must take the native path —
+        # witnesses are untrusted input and the digest must never be
+        # silently wrong, matching pack_witness_fused's explicit raise
+        fits_device = all(
+            len(n) < WITNESS_MAX_CHUNKS * RATE for n in nodes
+        )
+        if crypto_backend() == "tpu" and jax_device_ok() and fits_device and (
             device_offload_pays(sum(len(n) for n in nodes))
             if self._device_batch_floor < 0
             else len(nodes) >= self._device_batch_floor
@@ -169,6 +178,13 @@ class WitnessEngine:
         from phant_tpu.ops.keccak_jax import digests_to_bytes
         from phant_tpu.ops.witness_jax import _pow2ceil, witness_digests
 
+        limit = WITNESS_MAX_CHUNKS * RATE
+        for n in nodes:
+            if len(n) >= limit:
+                raise ValueError(
+                    f"node of {len(n)}B exceeds device absorb capacity "
+                    f"({limit}B); route to the native hasher"
+                )
         raw = b"".join(nodes)
         blob_len = _pow2ceil(len(raw) + WITNESS_MAX_CHUNKS * RATE)
         blob = np.zeros(blob_len, np.uint8)
@@ -178,12 +194,37 @@ class WitnessEngine:
         lens[: len(nodes)] = [len(n) for n in nodes]
         offsets = np.zeros(B, np.int32)
         np.cumsum(lens[:-1], out=offsets[1:])
-        out = witness_digests(
-            jnp.asarray(blob),
-            jnp.asarray(offsets),
-            jnp.asarray(lens),
-            max_chunks=WITNESS_MAX_CHUNKS,
-        )
+        import os
+
+        import jax
+
+        if (
+            os.environ.get("PHANT_ENGINE_SHARDED", "0") == "1"
+            and len(jax.devices()) > 1
+            and B % len(jax.devices()) == 0
+        ):
+            # multi-chip novelty hashing: shard the node axis over the
+            # mesh (opt-in — shard_map compiles bypass the persistent
+            # cache and the toggle is not thread-safe, see parallel/mesh)
+            from phant_tpu.parallel.mesh import (
+                make_mesh,
+                witness_digests_sharded,
+            )
+
+            out = witness_digests_sharded(
+                make_mesh(),
+                blob,
+                offsets,
+                lens,
+                max_chunks=WITNESS_MAX_CHUNKS,
+            )
+        else:
+            out = witness_digests(
+                jnp.asarray(blob),
+                jnp.asarray(offsets),
+                jnp.asarray(lens),
+                max_chunks=WITNESS_MAX_CHUNKS,
+            )
         return digests_to_bytes(np.asarray(out))[: len(nodes)]
 
     @staticmethod
@@ -255,6 +296,7 @@ class WitnessEngine:
         rows = np.empty(len(nodes), np.int64)
         novel: List[bytes] = []
         seen_this_call: Dict[bytes, int] = {}
+        hits_before = self.stats["hits"]
         for i, nb in enumerate(nodes):
             r = self._row_of_bytes.get(nb)
             if r is not None:
@@ -274,6 +316,9 @@ class WitnessEngine:
                 len(self._row_of_bytes) + len(novel) > self._max_nodes
                 and self._row_of_bytes  # an over-cap single batch still runs
             ):
+                # the pass above is discarded — roll back its hit tally so
+                # the stats RPC doesn't double-count the re-interned scan
+                self.stats["hits"] = hits_before
                 self._evict_all()
                 return self.intern(nodes)  # re-intern into the new generation
             digests = self._hash_batch(novel)

@@ -6,6 +6,7 @@ from phant_tpu.parallel.mesh import (
     init_distributed,
     make_mesh,
     shard_map,
+    witness_digests_sharded,
     witness_verify_fused_sharded,
     witness_verify_linked_sharded,
 )
@@ -16,6 +17,7 @@ __all__ = [
     "init_distributed",
     "make_mesh",
     "shard_map",
+    "witness_digests_sharded",
     "witness_verify_fused_sharded",
     "witness_verify_linked_sharded",
 ]

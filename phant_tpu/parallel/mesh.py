@@ -222,6 +222,38 @@ def witness_verify_linked_sharded(
     return (out[0] > 0) & (out[1] > 0)
 
 
+def witness_digests_sharded(mesh: Mesh, blob, offsets, lens, *, max_chunks: int = WITNESS_MAX_CHUNKS):
+    """The witness engine's novel-batch keccak (ops/witness_engine.py
+    _hash_batch_device) with the NODE axis sharded over `dp`: the blob is
+    replicated, each shard hashes its slice of nodes, outputs stay sharded
+    (no collective — hashing is embarrassingly parallel; the engine's
+    linkage join runs on host integers). This is the steady-state
+    multi-chip path: novel nodes per block are few, so one mesh dispatch
+    hashes a whole prefetch window's novelty.
+
+    The node axis must be divisible by the mesh size (callers pad to
+    powers of two)."""
+    axis = mesh.axis_names[0]
+
+    @functools.partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(P(), P(axis), P(axis)),
+        out_specs=P(axis),
+    )
+    def inner(blob_s, off_s, lens_s):
+        return witness_digests(blob_s, off_s, lens_s, max_chunks=max_chunks)
+
+    repl = NamedSharding(mesh, P())
+    col = NamedSharding(mesh, P(axis))
+    with _no_compile_cache():
+        return jax.jit(inner)(
+            jax.device_put(jnp.asarray(blob), repl),
+            jax.device_put(jnp.asarray(offsets), col),
+            jax.device_put(jnp.asarray(lens), col),
+        )
+
+
 # ---------------------------------------------------------------------------
 # sharded ecrecover (dp over the signature axis)
 # ---------------------------------------------------------------------------
@@ -259,7 +291,10 @@ def ecrecover_glv_sharded(mesh: Mesh, r, parity, mags, signs):
     with the signature axis sharded over `dp` — same embarrassingly
     parallel layout as ecrecover_sharded, ~2x the per-chip throughput.
     Returns (digests, valid, degenerate); degenerate elements must replay
-    on the exact CPU path, exactly as in the single-chip dispatch."""
+    on the exact CPU path, exactly as in the single-chip dispatch.
+
+    PRECONDITION: mags/signs must come from pack_glv_inputs (which screens
+    0 < r,s < N) — the kernel cannot detect an out-of-range s itself."""
     from phant_tpu.ops.secp256k1_jax import ecrecover_kernel_glv
 
     axis = mesh.axis_names[0]

@@ -148,3 +148,48 @@ def test_plan_cache_invalidated_on_mutation():
     trie.delete(keccak256(bytes([100])))
     r3 = trie_root_device(trie)
     assert r3 == trie.root_hash() == r1
+
+
+def test_batched_roots_match_host():
+    """K same-structure plans (value-mutated blobs) in one dispatch must
+    reproduce the host executor's root for every blob — the replay shape
+    that amortizes the device round trip over a span of blocks."""
+    import copy
+
+    from phant_tpu.ops.mpt_jax import execute_plan_host, trie_roots_device_batched
+
+    rng = np.random.default_rng(5)
+    trie = Trie()
+    for _ in range(64):
+        trie.put(keccak256(rng.bytes(20)), _account_leaf(rng))
+    plan = build_hash_plan(trie)
+    assert plan is not None
+
+    leaf_off, leaf_ln, _hp, _hc = plan.levels[0]
+    plans = []
+    for _k in range(4):
+        p = copy.copy(plan)
+        p.blob = plan.blob.copy()
+        p.device_args = None
+        for i in np.nonzero(leaf_ln)[0][:3]:
+            off = int(leaf_off[int(i)])
+            p.blob[off + 40 : off + 48] = np.frombuffer(rng.bytes(8), np.uint8)
+        plans.append(p)
+    got = trie_roots_device_batched(plans)
+    want = [execute_plan_host(p) for p in plans]
+    assert got == want
+    assert len(set(got)) == len(got)  # mutations actually changed the roots
+
+
+def test_batched_roots_reject_mismatched_structure():
+    from phant_tpu.ops.mpt_jax import trie_roots_device_batched
+
+    rng = np.random.default_rng(6)
+    t1, t2 = Trie(), Trie()
+    for _ in range(8):
+        t1.put(keccak256(rng.bytes(20)), _account_leaf(rng))
+    for _ in range(16):
+        t2.put(keccak256(rng.bytes(20)), _account_leaf(rng))
+    p1, p2 = build_hash_plan(t1), build_hash_plan(t2)
+    with pytest.raises(ValueError):
+        trie_roots_device_batched([p1, p2])

@@ -19,7 +19,18 @@ def _fresh_chain(genesis, fresh_state):
 
 @pytest.fixture(scope="module")
 def small_chain():
-    return _build_replay_chain(n_blocks=12, txs_per_block=3)
+    # _build_replay_chain returns picklable (…, genesis_accounts, …) so the
+    # bench can disk-cache chains; rebuild the fresh_state factory locally
+    from phant_tpu.state.statedb import StateDB
+
+    genesis, blocks, accounts, total, calls = _build_replay_chain(
+        n_blocks=12, txs_per_block=3
+    )
+
+    def fresh_state():
+        return StateDB({a: acct.copy() for a, acct in accounts.items()})
+
+    return genesis, blocks, fresh_state, total, calls
 
 
 def test_run_blocks_matches_serial(small_chain, monkeypatch):
