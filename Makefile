@@ -1,12 +1,19 @@
 # Commit gate (VERDICT r2 #4): `make check` must be green before a snapshot.
-.PHONY: check check-fast native
+.PHONY: check check-fast check-device native sanitize
 
 check:
 	./scripts/check.sh
 
-# Quick iteration subset (NOT a substitute for `make check` before commits).
+# Quick iteration subset (NOT a substitute for `make check` before commits):
+# skips the compile-heavy device-kernel files.
 check-fast:
-	python -m pytest tests/ -q -x -k "not tpu"
+	PHANT_CHECK_DEVICE=0 ./scripts/check.sh -x
+
+# Only the device-kernel files (CI runs this in parallel with check-fast).
+check-device:
+	python -m pytest tests/test_secp256k1_jax.py tests/test_secp256k1_glv.py \
+	  tests/test_keccak_jax.py tests/test_witness_jax.py \
+	  tests/test_witness_fused.py tests/test_mpt_jax.py tests/test_parallel.py -q
 
 native:
 	python -c "from phant_tpu.utils.native import build_native; print(build_native(verbose=True))"

@@ -38,12 +38,30 @@ def _needs_rebuild() -> bool:
     return any(src.stat().st_mtime > lib_mtime for src in _sources())
 
 
+def _arch_flags() -> list:
+    """-march=native only where it exists: aarch64 gcc spells it -mcpu and
+    cross-builds (reference CI cross-compiles aarch64, its ci.yml) must not
+    die on an x86-only flag. PHANT_NATIVE_ARCH_FLAGS overrides outright."""
+    import os
+    import platform
+
+    override = os.environ.get("PHANT_NATIVE_ARCH_FLAGS")
+    if override is not None:
+        return override.split()
+    machine = platform.machine().lower()
+    if machine in ("x86_64", "amd64", "i686"):
+        return ["-march=native"]
+    if machine in ("aarch64", "arm64"):
+        return ["-mcpu=native"]
+    return []
+
+
 def build_native(verbose: bool = False) -> Path:
     """Compile native/*.cc into build/libphant_native.so (idempotent)."""
     _BUILD_DIR.mkdir(exist_ok=True)
     if _needs_rebuild():
         cmd = [
-            "g++", "-O3", "-march=native", "-std=c++20", "-shared", "-fPIC",
+            "g++", "-O3", *_arch_flags(), "-std=c++20", "-shared", "-fPIC",
             "-fno-exceptions", "-fno-rtti", "-Wall",
             *(str(s) for s in _sources()),
             "-o", str(_LIB_PATH),

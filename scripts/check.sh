@@ -1,7 +1,34 @@
 #!/usr/bin/env bash
 # Commit gate: the FULL test suite must be green before any snapshot commit.
 # (VERDICT r1 #3 / r2 weak #1: two consecutive rounds shipped a red suite.)
+#
+# Speed (VERDICT r3 #6): the gate is XLA-compile-bound on this 1-core box,
+# so it keeps a PERSISTENT single-writer compile cache across runs
+# (build/jax_cache_tests — safe because the gate is one sequential pytest
+# process; the per-session tmp cache in conftest.py exists to isolate
+# CONCURRENT writers, which segfault jax). First run pays the cold
+# compiles once; every later gate run is warm. PHANT_CHECK_DEVICE=0 skips
+# the compile-heavy device-kernel files for a fast pre-commit loop (NOT a
+# substitute for the full gate).
+#
 # Usage: scripts/check.sh [extra pytest args]
 set -euo pipefail
 cd "$(dirname "$0")/.."
-python -m pytest tests/ -q "$@"
+export PHANT_JAX_CACHE="${PHANT_JAX_CACHE:-$PWD/build/jax_cache_tests}"
+mkdir -p "$PHANT_JAX_CACHE"
+
+start=$(date +%s)
+if [ "${PHANT_CHECK_DEVICE:-1}" = "0" ]; then
+  python -m pytest tests/ -q \
+    --ignore tests/test_secp256k1_jax.py \
+    --ignore tests/test_secp256k1_glv.py \
+    --ignore tests/test_keccak_jax.py \
+    --ignore tests/test_witness_jax.py \
+    --ignore tests/test_witness_fused.py \
+    --ignore tests/test_mpt_jax.py \
+    --ignore tests/test_parallel.py \
+    "$@"
+else
+  python -m pytest tests/ -q "$@"
+fi
+echo "[check] green in $(( $(date +%s) - start ))s (cache: $PHANT_JAX_CACHE)"
