@@ -229,11 +229,13 @@ class WitnessEngine:
         return digests_to_bytes(np.asarray(out))[: len(nodes)]
 
     @staticmethod
-    def _refs_for_batch(nodes: List[bytes]) -> List[List[bytes]]:
-        """Child hash references per node, batched through the native C
-        scanner when available (one call for the whole novel set); malformed
-        nodes — which the native scanner rejects wholesale — fall back to
-        the per-node Python walk that marks just the bad ones ref-less."""
+    def _refs_for_batch(nodes: List[bytes]) -> Tuple[List[bytes], np.ndarray]:
+        """(ref_digests, ref_node): the flat scan-order list of 32-byte
+        child references across the whole batch plus each ref's node index
+        (non-decreasing — scan order). Batched through the native C scanner
+        when available; malformed nodes — which the native scanner rejects
+        wholesale — fall back to the per-node Python walk that marks just
+        the bad ones ref-less."""
         from phant_tpu.utils.native import load_native
 
         native = load_native()
@@ -247,12 +249,17 @@ class WitnessEngine:
             try:
                 ref_off, ref_node = native.scan_refs(blob, offsets, lens)
             except ValueError:
-                return [_extract_ref_digests(n) for n in nodes]
-            out: List[List[bytes]] = [[] for _ in nodes]
-            for o, i in zip(ref_off.tolist(), ref_node.tolist()):
-                out[i].append(raw[o : o + 32])
-            return out
-        return [_extract_ref_digests(n) for n in nodes]
+                pass
+            else:
+                refs = [raw[o : o + 32] for o in ref_off.tolist()]
+                return refs, ref_node.astype(np.int64)
+        refs = []
+        ref_node_l = []
+        for i, nb in enumerate(nodes):
+            for r in _extract_ref_digests(nb):
+                refs.append(r)
+                ref_node_l.append(i)
+        return refs, np.asarray(ref_node_l, np.int64)
 
     # -- interning ----------------------------------------------------------
 
@@ -278,14 +285,6 @@ class WitnessEngine:
         self._refid_of_digest.clear()
         self._n_rows = 0
         self._n_refids = 0
-
-    def _refid(self, digest: bytes) -> int:
-        rid = self._refid_of_digest.get(digest)
-        if rid is None:
-            rid = self._n_refids
-            self._n_refids = rid + 1
-            self._refid_of_digest[digest] = rid
-        return rid
 
     def intern(self, nodes: Sequence[bytes]) -> np.ndarray:
         """Rows for `nodes`, hashing the never-seen ones in one batch.
@@ -329,23 +328,61 @@ class WitnessEngine:
                 self._evict_all()
                 return self.intern(nodes)  # re-intern into the new generation
             digests = self._hash_batch(novel)
-            refs_by_node = self._refs_for_batch(novel)
+            ref_digests, ref_node = self._refs_for_batch(novel)
             self.stats["hashed"] += len(novel)
             base_row = self._n_rows
             self._n_rows += len(novel)
             self._grow(self._n_rows)
             self._child_refids[base_row : self._n_rows] = _NO_ROW  # gen reuse
+
+            # per-node child slots FIRST: ref_node is non-decreasing (scan
+            # order), so the slot index is the offset from the node's first
+            # ref. Refs past the 17-slot cap (branch(16) + account storage
+            # root) are dropped BEFORE interning — adversarial deep-embedded
+            # RLP must not inflate the digest dict beyond the old
+            # 17-per-node bound
+            if len(ref_node):
+                slots = np.arange(len(ref_node)) - np.searchsorted(
+                    ref_node, ref_node
+                )
+                keep = slots < 17
+                if not keep.all():
+                    ref_digests = [
+                        ref_digests[k] for k in np.nonzero(keep)[0].tolist()
+                    ]
+                    ref_node = ref_node[keep]
+                    slots = slots[keep]
+
+            # bulk refid resolution: ONE C-level map over the digest dict
+            # for every digest in the batch (own digests first, then the
+            # flat ref list); only genuinely new digests take the Python
+            # assignment loop
+            all_dig = digests + ref_digests
+            ids = np.fromiter(
+                map(self._refid_of_digest.get, all_dig, itertools.repeat(-1)),
+                np.int64,
+                len(all_dig),
+            )
+            missing = np.nonzero(ids < 0)[0]
+            if len(missing):
+                rod = self._refid_of_digest
+                rid = self._n_refids
+                for k in missing.tolist():
+                    dg = all_dig[k]
+                    got = rod.get(dg)
+                    if got is None:
+                        rod[dg] = got = rid
+                        rid += 1
+                    ids[k] = got
+                self._n_refids = rid
+
+            nnovel = len(novel)
+            self._own_refid[base_row : base_row + nnovel] = ids[:nnovel]
+            if len(ref_node):
+                self._child_refids[base_row + ref_node, slots] = ids[nnovel:]
             row_of_bytes = self._row_of_bytes
-            own_refid = self._own_refid
-            child_refids = self._child_refids
-            refid = self._refid
-            for k, (nb, dg) in enumerate(zip(novel, digests)):
-                row = base_row + k
-                row_of_bytes[nb] = row
-                own_refid[row] = refid(dg)
-                refs = refs_by_node[k]
-                for slot, ref in enumerate(refs[:17]):
-                    child_refids[row, slot] = refid(ref)
+            for k, nb in enumerate(novel):
+                row_of_bytes[nb] = base_row + k
             # patch forward refs
             neg = rows < -1
             if neg.any():
