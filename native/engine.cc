@@ -1,0 +1,501 @@
+// Native witness-engine core: the memoized linked-multiproof verifier's
+// interning tables and verdict join, in C++ (the framework keeps the
+// runtime native where the reference's is — the reference's hot loop is
+// src/mpt/mpt.zig:38-119 + src/crypto/hasher.zig:4-17, recomputing every
+// node hash per block; this core is the redesigned cross-block engine
+// behind phant_tpu/ops/witness_engine.py).
+//
+// Division of labor: Python (witness_engine.WitnessEngine) keeps the
+// policy — batch assembly, the device/native hashing route for novel
+// nodes, eviction decisions, stats — and this core keeps the mechanism:
+//   * node-bytes -> row interning (open-addressing table keyed by a
+//     64-bit multiply-mix hash, exact bytes compare on probe, node bytes
+//     copied into an arena);
+//   * digest -> refid interning (every 32-byte digest that appears as a
+//     node's hash OR inside a node as a child reference gets one id, so
+//     parent->child linkage resolves at insert time);
+//   * per-row own_refid + 17 child-refid slots (branch(16) + account
+//     storage root), child references extracted by the same per-node RLP
+//     scan as native/packer.cc but per-node tolerant: a malformed node
+//     contributes no refs (it can still BE referenced), matching
+//     witness_engine._extract_ref_digests;
+//   * the batched verdict: block b verifies iff some node's digest equals
+//     root_b AND every node is that root or is hash-referenced by another
+//     node of block b — an epoch-stamped refid scan, zero cryptography.
+//
+// Protocol per verify_batch (driven from Python under the engine lock):
+//   scan(blob,offs,lens)  -> rows (row id, or -2-k for novel index k),
+//                            novel first-occurrence indices, miss count
+//   [Python hashes the novel nodes on the routed backend]
+//   commit(..., digests)  -> inserts novel rows, interns digests + refs,
+//                            patches the negative rows in place
+//   verdict(rows, block_offsets, roots) -> per-block 0/1
+//
+// Soundness notes: memoization keys are the FULL node bytes (hash match
+// is confirmed with memcmp), digest interning compares all 32 bytes, and
+// digests are only ever computed from full node bytes by the
+// differential-tested keccak backends — linking a foreign node would need
+// a keccak collision. The 64-bit table hashes are a perf detail only.
+
+#include <cstdint>
+#include <cstring>
+#include <cstddef>
+#include <vector>
+
+namespace {
+
+constexpr int kChildSlots = 17;
+
+inline uint64_t load64(const uint8_t* p) {
+  uint64_t v;
+  std::memcpy(&v, p, 8);
+  return v;
+}
+
+inline uint64_t load_tail(const uint8_t* p, size_t len) {
+  uint64_t v = 0;
+  std::memcpy(&v, p, len);
+  return v;
+}
+
+inline uint64_t mix(uint64_t a, uint64_t b) {
+  __uint128_t r = static_cast<__uint128_t>(a) * b;
+  return static_cast<uint64_t>(r) ^ static_cast<uint64_t>(r >> 64);
+}
+
+// Multiply-mix string hash (wyhash-family construction): 16B/iteration of
+// 128-bit multiply folding. Node bytes are untrusted but a collision only
+// costs a memcmp; the secrets below are fixed odd constants.
+uint64_t hash_bytes(const uint8_t* p, size_t len) {
+  constexpr uint64_t k0 = 0x9e3779b97f4a7c15ULL;
+  constexpr uint64_t k1 = 0xd1b54a32d192ed03ULL;
+  constexpr uint64_t k2 = 0x8bb84b93962eacc9ULL;
+  uint64_t h = mix(static_cast<uint64_t>(len) ^ k0, k2);
+  while (len >= 16) {
+    h = mix(load64(p) ^ k1, load64(p + 8) ^ h);
+    p += 16;
+    len -= 16;
+  }
+  uint64_t a = 0, b = 0;
+  if (len >= 8) {
+    a = load64(p);
+    b = load_tail(p + 8, len - 8);
+  } else if (len) {
+    b = load_tail(p, len);
+  }
+  return mix(a ^ k2, b ^ h);
+}
+
+inline uint64_t hash_digest(const uint8_t* d) {
+  // 32 uniform (or attacker-chosen) bytes; fold all four words so crafted
+  // child refs cannot cheaply collide the table key
+  return mix(load64(d) ^ 0x2545f4914f6cdd1dULL,
+             mix(load64(d + 8) ^ load64(d + 16), load64(d + 24) | 1ULL));
+}
+
+// --- RLP child-ref scan (per-node tolerant twin of packer.cc) --------------
+
+bool rlp_item(const uint8_t* d, size_t end, size_t* pos, int* kind,
+              size_t* ps, size_t* pe) {
+  if (*pos >= end) return false;
+  const uint8_t b = d[*pos];
+  size_t l, s;
+  if (b < 0x80) {
+    *kind = 0;
+    *ps = *pos;
+    *pe = *pos + 1;
+    *pos += 1;
+    return true;
+  }
+  if (b < 0xb8) {
+    l = b - 0x80;
+    s = *pos + 1;
+    *kind = 0;
+  } else if (b < 0xc0) {
+    const size_t ll = b - 0xb7;
+    if (*pos + 1 + ll > end) return false;
+    l = 0;
+    for (size_t i = 0; i < ll; ++i) l = (l << 8) | d[*pos + 1 + i];
+    s = *pos + 1 + ll;
+    *kind = 0;
+  } else if (b < 0xf8) {
+    l = b - 0xc0;
+    s = *pos + 1;
+    *kind = 1;
+  } else {
+    const size_t ll = b - 0xf7;
+    if (*pos + 1 + ll > end) return false;
+    l = 0;
+    for (size_t i = 0; i < ll; ++i) l = (l << 8) | d[*pos + 1 + i];
+    s = *pos + 1 + ll;
+    *kind = 1;
+  }
+  if (l > end || s + l > end) return false;
+  *ps = s;
+  *pe = s + l;
+  *pos = s + l;
+  return true;
+}
+
+long account_storage_root_off(const uint8_t* d, size_t s, size_t e) {
+  size_t pos = s;
+  int kind;
+  size_t ps, pe;
+  if (!rlp_item(d, e, &pos, &kind, &ps, &pe) || kind != 1 || pos != e)
+    return -1;
+  size_t ips[4], ipe[4];
+  int n = 0;
+  size_t p = ps;
+  while (p < pe) {
+    if (n >= 4) return -1;
+    int k;
+    if (!rlp_item(d, pe, &p, &k, &ips[n], &ipe[n]) || k != 0) return -1;
+    ++n;
+  }
+  if (n != 4 || ipe[2] - ips[2] != 32 || ipe[3] - ips[3] != 32) return -1;
+  return static_cast<long>(ips[2]);
+}
+
+// Collect child-ref offsets of one node's list payload into out[0..cap).
+// Returns the count, or -1 on malformed input (caller discards ALL of the
+// node's refs — the Python twin's catch-ValueError-return-[] contract).
+long scan_node_list(const uint8_t* d, size_t s, size_t e, size_t* out,
+                    long cap, long cnt, int depth) {
+  if (depth > 64) return -1;
+  int kinds[kChildSlots];
+  size_t pss[kChildSlots], pes[kChildSlots];
+  int nitems = 0;
+  size_t pos = s;
+  while (pos < e) {
+    if (nitems >= kChildSlots) return -1;
+    if (!rlp_item(d, e, &pos, &kinds[nitems], &pss[nitems], &pes[nitems]))
+      return -1;
+    ++nitems;
+  }
+  if (nitems == 17) {
+    for (int i = 0; i < 16; ++i) {
+      if (kinds[i] == 0 && pes[i] - pss[i] == 32) {
+        if (cnt < cap) out[cnt] = pss[i];
+        ++cnt;  // past-cap refs still count (they are DROPPED, not an error)
+      } else if (kinds[i] == 1 && pes[i] > pss[i]) {
+        cnt = scan_node_list(d, pss[i], pes[i], out, cap, cnt, depth + 1);
+        if (cnt < 0) return -1;
+      }
+    }
+  } else if (nitems == 2) {
+    if (pes[0] == pss[0]) return -1;  // hex-prefix path is never empty
+    const bool is_leaf = (d[pss[0]] & 0x20) != 0;
+    if (!is_leaf) {
+      if (kinds[1] == 0 && pes[1] - pss[1] == 32) {
+        if (cnt < cap) out[cnt] = pss[1];
+        ++cnt;
+      } else if (kinds[1] == 1) {
+        cnt = scan_node_list(d, pss[1], pes[1], out, cap, cnt, depth + 1);
+        if (cnt < 0) return -1;
+      }
+    } else if (kinds[1] == 0) {
+      const long sr = account_storage_root_off(d, pss[1], pes[1]);
+      if (sr >= 0) {
+        if (cnt < cap) out[cnt] = static_cast<size_t>(sr);
+        ++cnt;
+      }
+    }
+  }
+  return cnt;
+}
+
+// Refs of node [s, e): up to kChildSlots offsets (first in scan order, the
+// Python twin drops slots >= 17 before interning). 0 refs on malformed.
+int node_refs(const uint8_t* d, size_t s, size_t e, size_t* out) {
+  size_t pos = s;
+  int kind;
+  size_t ps, pe;
+  if (!rlp_item(d, e, &pos, &kind, &ps, &pe) || kind != 1 || pos != e)
+    return 0;
+  long cnt = scan_node_list(d, ps, pe, out, kChildSlots, 0, 0);
+  if (cnt < 0) return 0;
+  return static_cast<int>(cnt < kChildSlots ? cnt : kChildSlots);
+}
+
+// --- open-addressing tables -------------------------------------------------
+
+struct NodeEntry {
+  uint64_t hash;
+  uint64_t arena_off;
+  uint32_t len;
+  int32_t row;  // -1 = empty slot
+};
+
+struct DigestEntry {
+  uint64_t hash;
+  int32_t refid;  // -1 = empty slot
+  uint8_t digest[32];
+};
+
+struct Engine {
+  // node interning
+  std::vector<NodeEntry> ntab;
+  std::vector<uint8_t> arena;
+  uint64_t n_nodes = 0;
+  // digest interning
+  std::vector<DigestEntry> dtab;
+  uint64_t n_digests = 0;
+  // per-row linkage
+  std::vector<int32_t> own_refid;
+  std::vector<int32_t> child_refids;  // n_rows * kChildSlots, -1 sentinel
+  // verdict scratch: stamp[refid] = tag of the last block referencing it
+  std::vector<uint64_t> stamp;
+  uint64_t stamp_serial = 0;
+  // batch scratch (scan -> commit)
+  std::vector<uint32_t> novel_dup;  // open table over this batch's novel set
+
+  Engine() {
+    ntab.resize(1 << 12);
+    for (auto& e : ntab) e.row = -1;
+    dtab.resize(1 << 13);
+    for (auto& e : dtab) e.refid = -1;
+  }
+
+  void flush() {
+    for (auto& e : ntab) e.row = -1;
+    for (auto& e : dtab) e.refid = -1;
+    arena.clear();
+    own_refid.clear();
+    child_refids.clear();
+    stamp.clear();
+    stamp_serial = 0;
+    n_nodes = 0;
+    n_digests = 0;
+  }
+
+  void grow_ntab() {
+    std::vector<NodeEntry> old;
+    old.swap(ntab);
+    ntab.resize(old.size() * 2);
+    for (auto& e : ntab) e.row = -1;
+    const uint64_t mask = ntab.size() - 1;
+    for (const auto& e : old) {
+      if (e.row < 0) continue;
+      uint64_t i = e.hash & mask;
+      while (ntab[i].row >= 0) i = (i + 1) & mask;
+      ntab[i] = e;
+    }
+  }
+
+  void grow_dtab() {
+    std::vector<DigestEntry> old;
+    old.swap(dtab);
+    dtab.resize(old.size() * 2);
+    for (auto& e : dtab) e.refid = -1;
+    const uint64_t mask = dtab.size() - 1;
+    for (const auto& e : old) {
+      if (e.refid < 0) continue;
+      uint64_t i = e.hash & mask;
+      while (dtab[i].refid >= 0) i = (i + 1) & mask;
+      dtab[i] = e;
+    }
+  }
+
+  // row of node bytes, or -1
+  int32_t find_node(const uint8_t* p, uint32_t len, uint64_t h) const {
+    const uint64_t mask = ntab.size() - 1;
+    uint64_t i = h & mask;
+    while (true) {
+      const NodeEntry& e = ntab[i];
+      if (e.row < 0) return -1;
+      if (e.hash == h && e.len == len &&
+          std::memcmp(arena.data() + e.arena_off, p, len) == 0)
+        return e.row;
+      i = (i + 1) & mask;
+    }
+  }
+
+  void insert_node(const uint8_t* p, uint32_t len, uint64_t h, int32_t row) {
+    if ((n_nodes + 1) * 10 >= ntab.size() * 7) grow_ntab();
+    const uint64_t off = arena.size();
+    arena.insert(arena.end(), p, p + len);
+    const uint64_t mask = ntab.size() - 1;
+    uint64_t i = h & mask;
+    while (ntab[i].row >= 0) i = (i + 1) & mask;
+    ntab[i] = NodeEntry{h, off, len, row};
+    ++n_nodes;
+  }
+
+  int32_t find_refid(const uint8_t* d) const {
+    const uint64_t h = hash_digest(d);
+    const uint64_t mask = dtab.size() - 1;
+    uint64_t i = h & mask;
+    while (true) {
+      const DigestEntry& e = dtab[i];
+      if (e.refid < 0) return -1;
+      if (e.hash == h && std::memcmp(e.digest, d, 32) == 0) return e.refid;
+      i = (i + 1) & mask;
+    }
+  }
+
+  int32_t intern_digest(const uint8_t* d) {
+    if ((n_digests + 1) * 10 >= dtab.size() * 7) grow_dtab();
+    const uint64_t h = hash_digest(d);
+    const uint64_t mask = dtab.size() - 1;
+    uint64_t i = h & mask;
+    while (true) {
+      DigestEntry& e = dtab[i];
+      if (e.refid < 0) {
+        e.hash = h;
+        e.refid = static_cast<int32_t>(n_digests++);
+        std::memcpy(e.digest, d, 32);
+        return e.refid;
+      }
+      if (e.hash == h && std::memcmp(e.digest, d, 32) == 0) return e.refid;
+      i = (i + 1) & mask;
+    }
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+void* phant_engine_new() { return new Engine(); }
+
+void phant_engine_free(void* h) { delete static_cast<Engine*>(h); }
+
+void phant_engine_flush(void* h) { static_cast<Engine*>(h)->flush(); }
+
+uint64_t phant_engine_nodes(void* h) {
+  return static_cast<Engine*>(h)->n_nodes;
+}
+
+uint64_t phant_engine_digests(void* h) {
+  return static_cast<Engine*>(h)->n_digests;
+}
+
+// Hit-scan the batch. rows[i] = row id for known nodes, or -2 - k where k
+// indexes this batch's novel first-occurrence list (duplicates of one novel
+// byte-string share k). novel_idx (caller-sized >= n) receives the batch
+// index of each novel first occurrence. counts[0] = miss occurrences
+// (novel duplicates included — the "hits" complement), counts[1] = number
+// of novel first occurrences. Returns 0.
+int phant_engine_scan(void* h, const uint8_t* blob, const uint64_t* offs,
+                      const uint32_t* lens, uint64_t n, int64_t* rows,
+                      uint32_t* novel_idx, uint64_t* counts) {
+  Engine& E = *static_cast<Engine*>(h);
+  uint64_t miss = 0, novel = 0;
+  // per-batch dup table: open addressing over novel first occurrences
+  uint64_t dcap = 64;
+  while (dcap < n * 2) dcap <<= 1;
+  E.novel_dup.assign(dcap, UINT32_MAX);
+  const uint64_t dmask = dcap - 1;
+  for (uint64_t i = 0; i < n; ++i) {
+    const uint8_t* p = blob + offs[i];
+    const uint32_t len = lens[i];
+    const uint64_t hsh = hash_bytes(p, len);
+    const int32_t row = E.find_node(p, len, hsh);
+    if (row >= 0) {
+      rows[i] = row;
+      continue;
+    }
+    ++miss;
+    // dup among this batch's novels?
+    uint64_t j = hsh & dmask;
+    int64_t found = -1;
+    while (E.novel_dup[j] != UINT32_MAX) {
+      // the table stores novel-list indices; novel_idx[cand] = batch index
+      const uint32_t cand = E.novel_dup[j];
+      const uint8_t* cp = blob + offs[novel_idx[cand]];
+      const uint32_t cl = lens[novel_idx[cand]];
+      if (cl == len && std::memcmp(cp, p, len) == 0) {
+        found = cand;
+        break;
+      }
+      j = (j + 1) & dmask;
+    }
+    if (found >= 0) {
+      rows[i] = -2 - found;
+      continue;
+    }
+    novel_idx[novel] = static_cast<uint32_t>(i);
+    E.novel_dup[j] = static_cast<uint32_t>(novel);
+    rows[i] = -2 - static_cast<int64_t>(novel);
+    ++novel;
+  }
+  counts[0] = miss;
+  counts[1] = novel;
+  return 0;
+}
+
+// Insert the scanned batch's novel nodes (digests[32*k] = keccak of novel
+// k, computed by the caller on the routed backend), intern their digests
+// and child references, fill the per-row link slots, and patch every
+// negative row in rows[0..n) to its real row id. Returns the base row.
+int64_t phant_engine_commit(void* h, const uint8_t* blob,
+                            const uint64_t* offs, const uint32_t* lens,
+                            uint64_t n, int64_t* rows,
+                            const uint32_t* novel_idx, uint64_t n_novel,
+                            const uint8_t* digests) {
+  Engine& E = *static_cast<Engine*>(h);
+  const int64_t base_row = static_cast<int64_t>(E.own_refid.size());
+  E.own_refid.resize(base_row + n_novel);
+  E.child_refids.resize((base_row + n_novel) * kChildSlots, -1);
+  size_t ref_off[kChildSlots];
+  for (uint64_t k = 0; k < n_novel; ++k) {
+    const uint64_t i = novel_idx[k];
+    const uint8_t* p = blob + offs[i];
+    const uint32_t len = lens[i];
+    E.insert_node(p, len, hash_bytes(p, len),
+                  static_cast<int32_t>(base_row + k));
+    E.own_refid[base_row + k] = E.intern_digest(digests + 32 * k);
+    const int nref = node_refs(blob, offs[i], offs[i] + len, ref_off);
+    int32_t* slots = E.child_refids.data() + (base_row + k) * kChildSlots;
+    for (int r = 0; r < nref; ++r)
+      slots[r] = E.intern_digest(blob + ref_off[r]);
+  }
+  for (uint64_t i = 0; i < n; ++i)
+    if (rows[i] < -1) rows[i] = base_row + (-2 - rows[i]);
+  return base_row;
+}
+
+// Per-block linked-multiproof verdicts. block b = rows[block_offs[b] ..
+// block_offs[b+1]); roots = 32B per block; ok[b] = 1 iff some node's
+// digest equals root_b and every node is that root or is referenced by a
+// same-block node. Exactly witness_engine._verify_interned's semantics.
+int phant_engine_verdict(void* h, const int64_t* rows,
+                         const uint64_t* block_offs, uint64_t n_blocks,
+                         const uint8_t* roots, uint8_t* ok) {
+  Engine& E = *static_cast<Engine*>(h);
+  if (E.stamp.size() < E.n_digests) E.stamp.resize(E.n_digests, 0);
+  for (uint64_t b = 0; b < n_blocks; ++b) {
+    const uint64_t s = block_offs[b], e = block_offs[b + 1];
+    if (e <= s) {
+      ok[b] = 0;
+      continue;
+    }
+    const int32_t root_refid = E.find_refid(roots + 32 * b);
+    const uint64_t tag = ++E.stamp_serial;
+    // pass 1: stamp every child reference of the block's nodes
+    for (uint64_t i = s; i < e; ++i) {
+      const int32_t* slots = E.child_refids.data() + rows[i] * kChildSlots;
+      for (int r = 0; r < kChildSlots; ++r) {
+        const int32_t c = slots[r];
+        if (c < 0) break;  // slots fill left-to-right
+        E.stamp[c] = tag;
+      }
+    }
+    // pass 2: every node must be referenced or be the root; the root must
+    // be PRESENT as some node's own digest
+    uint8_t all_ok = 1, root_present = 0;
+    for (uint64_t i = s; i < e; ++i) {
+      const int32_t own = E.own_refid[rows[i]];
+      const uint8_t is_root = own == root_refid;
+      root_present |= is_root;
+      if (!is_root && E.stamp[own] != tag) {
+        all_ok = 0;
+        break;
+      }
+    }
+    ok[b] = all_ok & root_present;
+  }
+  return 0;
+}
+
+}  // extern "C"

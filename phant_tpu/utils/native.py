@@ -120,6 +120,33 @@ class NativeLib:
             ctypes.c_char_p, ctypes.c_char_p,
         ]
         lib.phant_ecrecover_batch.restype = None
+        self.has_engine = hasattr(lib, "phant_engine_new")
+        if self.has_engine:
+            lib.phant_engine_new.argtypes = []
+            lib.phant_engine_new.restype = ctypes.c_void_p
+            lib.phant_engine_free.argtypes = [ctypes.c_void_p]
+            lib.phant_engine_free.restype = None
+            lib.phant_engine_flush.argtypes = [ctypes.c_void_p]
+            lib.phant_engine_flush.restype = None
+            lib.phant_engine_nodes.argtypes = [ctypes.c_void_p]
+            lib.phant_engine_nodes.restype = ctypes.c_uint64
+            lib.phant_engine_digests.argtypes = [ctypes.c_void_p]
+            lib.phant_engine_digests.restype = ctypes.c_uint64
+            lib.phant_engine_scan.argtypes = [ctypes.c_void_p] + [
+                ctypes.c_void_p
+            ] * 3 + [ctypes.c_uint64, ctypes.c_void_p, ctypes.c_void_p,
+                     ctypes.c_void_p]
+            lib.phant_engine_scan.restype = ctypes.c_int
+            lib.phant_engine_commit.argtypes = [ctypes.c_void_p] + [
+                ctypes.c_void_p
+            ] * 3 + [ctypes.c_uint64, ctypes.c_void_p, ctypes.c_void_p,
+                     ctypes.c_uint64, ctypes.c_char_p]
+            lib.phant_engine_commit.restype = ctypes.c_int64
+            lib.phant_engine_verdict.argtypes = [
+                ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+                ctypes.c_uint64, ctypes.c_char_p, ctypes.c_void_p,
+            ]
+            lib.phant_engine_verdict.restype = ctypes.c_int
 
     def keccak256(self, data: bytes) -> bytes:
         out = ctypes.create_string_buffer(32)
@@ -216,6 +243,10 @@ class NativeLib:
             raise ValueError("malformed RLP in witness node")
         return ref_off[:cnt], ref_node[:cnt]
 
+    def new_engine(self) -> Optional["EngineCore"]:
+        """Fresh native witness-engine core (None on an old library)."""
+        return EngineCore(self._lib) if self.has_engine else None
+
     def keccak256_batch(self, payloads: Sequence[bytes]) -> List[bytes]:
         n = len(payloads)
         if n == 0:
@@ -225,6 +256,89 @@ class NativeLib:
         self._lib.phant_keccak256_batch(blob, offsets, lens, n, out)
         raw = out.raw
         return [raw[32 * i : 32 * i + 32] for i in range(n)]
+
+
+class EngineCore:
+    """Handle to one native witness-engine core (native/engine.cc): the
+    interning tables + verdict join of ops/witness_engine.WitnessEngine,
+    kept in C++. The Python engine drives the scan/hash/commit/verdict
+    protocol and keeps policy (hashing backend route, eviction, stats)."""
+
+    def __init__(self, lib: ctypes.CDLL):
+        self._lib = lib
+        self._h = lib.phant_engine_new()
+        import weakref
+
+        # bind finalizer args by value — no ref back to self
+        self._finalizer = weakref.finalize(
+            self, lib.phant_engine_free, self._h
+        )
+
+    @property
+    def nodes(self) -> int:
+        return int(self._lib.phant_engine_nodes(self._h))
+
+    @property
+    def digests(self) -> int:
+        return int(self._lib.phant_engine_digests(self._h))
+
+    def flush(self) -> None:
+        self._lib.phant_engine_flush(self._h)
+
+    def scan(self, blob, offsets, lens):
+        """(rows i64[n], novel_idx u32[n_novel], miss_count). rows[i] is a
+        row id or -2-k for the k-th novel first occurrence of the batch."""
+        import numpy as np
+
+        n = len(lens)
+        rows = np.empty(n, np.int64)
+        novel_idx = np.empty(n, np.uint32)
+        counts = np.zeros(2, np.uint64)
+        rc = self._lib.phant_engine_scan(
+            self._h,
+            blob.ctypes.data_as(ctypes.c_void_p),
+            offsets.ctypes.data_as(ctypes.c_void_p),
+            lens.ctypes.data_as(ctypes.c_void_p),
+            n,
+            rows.ctypes.data_as(ctypes.c_void_p),
+            novel_idx.ctypes.data_as(ctypes.c_void_p),
+            counts.ctypes.data_as(ctypes.c_void_p),
+        )
+        if rc != 0:
+            raise RuntimeError(f"engine scan failed ({rc})")
+        return rows, novel_idx[: int(counts[1])], int(counts[0])
+
+    def commit(self, blob, offsets, lens, rows, novel_idx, digests: bytes):
+        """Insert the scanned novel nodes with their (caller-computed)
+        digests; patches the negative entries of `rows` in place."""
+        self._lib.phant_engine_commit(
+            self._h,
+            blob.ctypes.data_as(ctypes.c_void_p),
+            offsets.ctypes.data_as(ctypes.c_void_p),
+            lens.ctypes.data_as(ctypes.c_void_p),
+            len(lens),
+            rows.ctypes.data_as(ctypes.c_void_p),
+            novel_idx.ctypes.data_as(ctypes.c_void_p),
+            len(novel_idx),
+            digests,
+        )
+
+    def verdict(self, rows, block_offs, roots: bytes):
+        """(n_blocks,) bool verdicts; block b = rows[block_offs[b]:
+        block_offs[b+1]], roots = concatenated 32B root digests."""
+        import numpy as np
+
+        n_blocks = len(block_offs) - 1
+        ok = np.zeros(n_blocks, np.uint8)
+        self._lib.phant_engine_verdict(
+            self._h,
+            rows.ctypes.data_as(ctypes.c_void_p),
+            block_offs.ctypes.data_as(ctypes.c_void_p),
+            n_blocks,
+            roots,
+            ok.ctypes.data_as(ctypes.c_void_p),
+        )
+        return ok.astype(bool)
 
 
 def load_native() -> Optional[NativeLib]:

@@ -36,6 +36,22 @@ def _witness(trie, keys, picks, rng):
     return list(nodes.keys())
 
 
+@pytest.fixture(params=["native", "python"], autouse=True)
+def engine_core(request, monkeypatch):
+    """Run every test in this module against BOTH engine cores: the C++
+    one (native/engine.cc) and the pure-Python twin it must match."""
+    monkeypatch.setenv(
+        "PHANT_ENGINE_NATIVE", "1" if request.param == "native" else "0"
+    )
+    if request.param == "native":
+        from phant_tpu.utils.native import load_native
+
+        lib = load_native()
+        if lib is None or not lib.has_engine:
+            pytest.skip("native engine core unavailable")
+    return request.param
+
+
 @pytest.fixture()
 def setup():
     trie, keys, root = _build_trie()
@@ -274,3 +290,66 @@ def test_eviction_does_not_inflate_hit_stats():
     eng.intern(a + [b"\x04" * 40, b"\x05" * 40])
     assert eng.stats["evictions"] == 1
     assert eng.stats["hits"] == 0
+
+
+def test_native_vs_python_core_differential(engine_core, monkeypatch):
+    """The C++ core (native/engine.cc) and the Python engine must return
+    identical verdict arrays and hashed/hit counters on a gauntlet of
+    adversarial batches: duplicate nodes, zero-length and malformed RLP
+    nodes, deep-embedded ref inflation (>17 refs), unknown roots,
+    cross-batch memoization and eviction. This is the soundness contract
+    of swapping the core."""
+    if engine_core != "native":
+        pytest.skip("constructs both cores itself; one run suffices")
+    from phant_tpu.utils.native import load_native
+
+    lib = load_native()
+    if lib is None or not lib.has_engine:
+        pytest.skip("native engine core unavailable")
+
+    trie, keys, root = _build_trie(n=128, seed=21)
+    rng = np.random.default_rng(77)
+    batches = []
+    for _ in range(6):
+        wit = [(root, _witness(trie, keys, 6, rng)) for _ in range(5)]
+        batches.append(wit)
+
+    # adversarial extras
+    nodes0 = list(batches[0][0][1])
+    malformed = b"\xc3\x01"  # list header longer than payload
+    not_a_list = b"\x85hello"
+    # branch with an embedded list that nests 20 x 32-byte strings (ref
+    # inflation attempt past the 17-slot cap)
+    from phant_tpu import rlp as _rlp
+    deep = _rlp.encode([_rlp.encode([rng.bytes(32) for _ in range(20)])] + [b""] * 15 + [b"v"])
+    dup = nodes0[0]
+    batches.append(
+        [
+            (root, nodes0 + [malformed]),
+            (root, nodes0 + [not_a_list]),
+            (root, nodes0 + [deep]),
+            (root, nodes0 + [b""]),        # zero-length node bytes
+            (root, [dup, dup] + nodes0),
+            (b"\x07" * 32, nodes0),       # unknown root digest
+            (root, []),                    # empty witness
+            (root, [dup]) if keccak256(dup) != root else (root, nodes0),
+        ]
+    )
+
+    monkeypatch.setenv("PHANT_ENGINE_NATIVE", "1")
+    eng_n = WitnessEngine(max_nodes=200)  # small cap: exercise eviction
+    assert eng_n._core is not None
+    monkeypatch.setenv("PHANT_ENGINE_NATIVE", "0")
+    eng_p = WitnessEngine(max_nodes=200)
+    assert eng_p._core is None
+
+    for wit in batches:
+        out_n = eng_n.verify_batch(wit)
+        out_p = eng_p.verify_batch(wit)
+        assert (out_n == out_p).all(), (out_n, out_p)
+    assert eng_n.stats["hashed"] == eng_p.stats["hashed"]
+    assert eng_n.stats["hits"] == eng_p.stats["hits"]
+    assert eng_n.stats["evictions"] == eng_p.stats["evictions"]
+    sn, sp = eng_n.stats_snapshot(), eng_p.stats_snapshot()
+    assert sn["interned_nodes"] == sp["interned_nodes"]
+    assert sn["interned_digests"] == sp["interned_digests"]
