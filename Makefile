@@ -23,7 +23,7 @@ native:
 sanitize:
 	mkdir -p build
 	g++ -std=c++17 -O1 -g -fsanitize=address,undefined -fno-sanitize-recover=all \
-	  -Wall -Werror -o build/native_selftest \
+	  -Wall -Werror -Wno-maybe-uninitialized -o build/native_selftest \
 	  native/keccak.cc native/packer.cc native/secp256k1.cc native/engine.cc \
 	  native/selftest.cc
 	./build/native_selftest

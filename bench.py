@@ -537,7 +537,7 @@ def _native_hasher():
     native = load_native()
     if native is None:
         return None
-    return lambda nodes: native.keccak256_batch(nodes)
+    return lambda nodes: native.keccak256_batch_fast(nodes)
 
 
 def _tunnel_profile() -> dict:
@@ -556,12 +556,19 @@ def _tunnel_profile() -> dict:
         return {"tunnel_probe_error": repr(e)[:120]}
 
 
-def verify_cpu(witnesses) -> int:
+def verify_cpu(witnesses, fast_keccak: bool = False) -> int:
     """CPU baseline: FULL linked verification per block on the native path —
     batch keccak every node, scan child refs (C++ RLP scanner), and check
     that every node is the root or hash-referenced by a same-block node
     (equivalent to subtree connectivity: hash references are acyclic).
-    Returns the number of verified blocks."""
+    Returns the number of verified blocks.
+
+    Hashing is the SCALAR batch by default — the reference-equivalent
+    baseline (the reference hashes one node at a time through Zig std,
+    src/crypto/hasher.zig:4-17; SURVEY.md pins the north-star ratio to the
+    'Zig-CPU baseline'). fast_keccak=True swaps in the framework's 8-way
+    AVX-512 batch so the artifact also records what the same full-recompute
+    architecture does with our SIMD primitive (transparency row)."""
     from phant_tpu.utils.native import load_native
 
     native = load_native()
@@ -570,9 +577,12 @@ def verify_cpu(witnesses) -> int:
 
         return sum(bool(verify_witness_linked(r, n)) for r, n in witnesses)
 
+    hash_batch = (
+        native.keccak256_batch_fast if fast_keccak else native.keccak256_batch
+    )
     ok = 0
     for root, nodes in witnesses:
-        digests = native.keccak256_batch(nodes)
+        digests = hash_batch(nodes)
         raw = b"".join(nodes)
         lens = np.asarray([len(n) for n in nodes], np.uint32)
         offsets = np.zeros(len(nodes), np.uint64)
@@ -634,6 +644,12 @@ def sec_engine_cpu() -> dict:
         cpu_s = min(cpu_s, time.perf_counter() - t0)
         assert ok_cpu == n_blocks
     cpu_rate = n_blocks / cpu_s
+    # transparency: the same full-recompute baseline with OUR SIMD keccak
+    fastk_s = float("inf")
+    for _ in range(2):
+        t0 = time.perf_counter()
+        assert verify_cpu(span, fast_keccak=True) == n_blocks
+        fastk_s = min(fastk_s, time.perf_counter() - t0)
 
     # engine on native C hashing (architecture-only contribution)
     ecpu_s, novel, _st, eng = _run_engine(warm, span)
@@ -647,6 +663,7 @@ def sec_engine_cpu() -> dict:
 
     return {
         "cpu_baseline_blocks_per_sec": round(cpu_rate, 2),
+        "cpu_baseline_fastkeccak_blocks_per_sec": round(n_blocks / fastk_s, 2),
         "engine_cpu_blocks_per_sec": round(n_blocks / ecpu_s, 2),
         "engine_cached_ceiling_blocks_per_sec": round(n_blocks / cached_s, 2),
         "novel_nodes_per_block": round(novel / n_blocks, 1) if novel else None,
@@ -901,10 +918,23 @@ def sec_keccak_cpu() -> dict:
         for p in payloads:
             keccak256(p)
         cpu_s = time.perf_counter() - t0
-    return {
+    out = {
         "keccak_cpu_hashes_per_sec": round(N / cpu_s, 1),
         "keccak_batch": N,
     }
+    if native is not None and native.has_fast_keccak:
+        # the framework's 8-way AVX-512 multi-buffer batch (bit-identical
+        # digests; scalar row above stays the reference-equivalent baseline)
+        assert native.keccak256_batch_fast(payloads) == native.keccak256_batch(
+            payloads
+        )
+        simd_s = float("inf")
+        for _ in range(5):
+            t0 = time.perf_counter()
+            native.keccak256_batch_fast(payloads)
+            simd_s = min(simd_s, time.perf_counter() - t0)
+        out["keccak_cpu_simd_hashes_per_sec"] = round(N / simd_s, 1)
+    return out
 
 
 def sec_keccak_device() -> dict:
@@ -1068,28 +1098,50 @@ def _replay(backend: str, verify_root: bool) -> dict:
     if native_available():
         set_evm_backend("native")
     set_crypto_backend(backend)
+    out: dict = {}
+    prefix = f"replay_{'stateroot_' if verify_root else ''}{backend}"
     try:
-        chain = Blockchain(
-            1,
-            StateDB({a: acct.copy() for a, acct in genesis_accounts.items()}),
-            genesis,
-            verify_state_root=verify_root,
-        )
-        t0 = time.perf_counter()
-        # run_blocks pipelines device sender recovery across blocks on the
-        # tpu backend and is a plain loop on cpu
-        chain.run_blocks(blocks)
-        dt = time.perf_counter() - t0
+        # device variants run TWICE: the first pass eats the XLA kernel
+        # compiles (the axon remote-compile path does not reuse the
+        # persistent cache across processes, so a single cold pass times a
+        # multi-minute compile as if it were replay — r4 interim artifacts
+        # recorded 2.9 blocks/s cold vs 142+ warm for the SAME code). The
+        # cold pass is banked for transparency; the steady-state pass is
+        # the headline number.
+        passes = 2 if backend != "cpu" else 1
+        dt = float("inf")
+        for p in range(passes):
+            chain = Blockchain(
+                1,
+                StateDB(
+                    {a: acct.copy() for a, acct in genesis_accounts.items()}
+                ),
+                genesis,
+                verify_state_root=verify_root,
+            )
+            t0 = time.perf_counter()
+            # run_blocks pipelines device sender recovery across blocks on
+            # the tpu backend and is a plain loop on cpu
+            chain.run_blocks(blocks)
+            pass_s = time.perf_counter() - t0
+            if passes > 1 and p == 0:
+                out[f"{prefix}_cold_blocks_per_sec"] = round(
+                    n_blocks / pass_s, 1
+                )
+                _bank(dict(out))
+            dt = min(dt, pass_s)
     finally:
         set_crypto_backend("cpu")
         set_evm_backend("python")
-    key = f"replay_{'stateroot_' if verify_root else ''}{backend}_blocks_per_sec"
-    return {
-        key: round(n_blocks / dt, 1),
-        "replay_blocks": n_blocks,
-        "replay_txs_per_block": total_txs,
-        "replay_contract_calls_per_block": n_calls,
-    }
+    out.update(
+        {
+            f"{prefix}_blocks_per_sec": round(n_blocks / dt, 1),
+            "replay_blocks": n_blocks,
+            "replay_txs_per_block": total_txs,
+            "replay_contract_calls_per_block": n_calls,
+        }
+    )
+    return out
 
 
 def _bank(frag: dict) -> None:

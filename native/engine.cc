@@ -40,6 +40,7 @@
 #include <cstdint>
 #include <cstring>
 #include <cstddef>
+#include <chrono>
 #include <vector>
 
 namespace {
@@ -66,11 +67,20 @@ inline uint64_t mix(uint64_t a, uint64_t b) {
 // Multiply-mix string hash (wyhash-family construction): 16B/iteration of
 // 128-bit multiply folding. Node bytes are untrusted but a collision only
 // costs a memcmp; the secrets below are fixed odd constants.
-uint64_t hash_bytes(const uint8_t* p, size_t len) {
+uint64_t hash_bytes(const uint8_t* p, size_t len, uint64_t seed) {
   constexpr uint64_t k0 = 0x9e3779b97f4a7c15ULL;
   constexpr uint64_t k1 = 0xd1b54a32d192ed03ULL;
   constexpr uint64_t k2 = 0x8bb84b93962eacc9ULL;
-  uint64_t h = mix(static_cast<uint64_t>(len) ^ k0, k2);
+  constexpr uint64_t k3 = 0x589965cc75374cc3ULL;
+  uint64_t h = mix(static_cast<uint64_t>(len) ^ k0 ^ seed, k2);
+  uint64_t g = h ^ k3;
+  // two independent 16B multiply chains per iteration (ILP)
+  while (len >= 32) {
+    h = mix(load64(p) ^ k1, load64(p + 8) ^ h);
+    g = mix(load64(p + 16) ^ k2, load64(p + 24) ^ g);
+    p += 32;
+    len -= 32;
+  }
   while (len >= 16) {
     h = mix(load64(p) ^ k1, load64(p + 8) ^ h);
     p += 16;
@@ -83,14 +93,17 @@ uint64_t hash_bytes(const uint8_t* p, size_t len) {
   } else if (len) {
     b = load_tail(p, len);
   }
-  return mix(a ^ k2, b ^ h);
+  return mix(a ^ k2, (b ^ h) + g);
 }
 
-inline uint64_t hash_digest(const uint8_t* d) {
-  // 32 uniform (or attacker-chosen) bytes; fold all four words so crafted
-  // child refs cannot cheaply collide the table key
-  return mix(load64(d) ^ 0x2545f4914f6cdd1dULL,
-             mix(load64(d + 8) ^ load64(d + 16), load64(d + 24) | 1ULL));
+inline uint64_t hash_digest(const uint8_t* d, uint64_t seed) {
+  // 32 uniform (or attacker-chosen) bytes; one 128-bit multiply over the
+  // first two words, keyed by the per-engine secret seed. Without the
+  // seed any public mix is invertible and crafted child refs could grow
+  // one probe chain quadratically (perf DoS only — the full-digest
+  // memcmp keeps correctness either way). This runs ~17x per novel node,
+  // the hot half of commit, so it stays two loads + one multiply.
+  return mix(load64(d) ^ seed, load64(d + 8) ^ 0x9e3779b97f4a7c15ULL);
 }
 
 // --- RLP child-ref scan (per-node tolerant twin of packer.cc) --------------
@@ -226,10 +239,14 @@ struct NodeEntry {
   int32_t row;  // -1 = empty slot
 };
 
+// Probe entry is 16B (4 per cache line); digest bytes live in a separate
+// refid-indexed arena written sequentially — commit's ~17 intern_digest
+// calls per novel node are memory-bound, so the probe path touches as few
+// random lines as possible.
 struct DigestEntry {
   uint64_t hash;
   int32_t refid;  // -1 = empty slot
-  uint8_t digest[32];
+  uint32_t pad;
 };
 
 struct Engine {
@@ -239,6 +256,7 @@ struct Engine {
   uint64_t n_nodes = 0;
   // digest interning
   std::vector<DigestEntry> dtab;
+  std::vector<uint8_t> digest_arena;  // 32B per refid, refid-indexed
   uint64_t n_digests = 0;
   // per-row linkage
   std::vector<int32_t> own_refid;
@@ -246,19 +264,30 @@ struct Engine {
   // verdict scratch: stamp[refid] = tag of the last block referencing it
   std::vector<uint64_t> stamp;
   uint64_t stamp_serial = 0;
+  // secret table seed: keys both hashes so untrusted witness bytes cannot
+  // engineer probe-chain collisions (address + clock entropy, mixed)
+  uint64_t seed;
   // batch scratch (scan -> commit)
   std::vector<uint32_t> novel_dup;  // open table over this batch's novel set
+  std::vector<const uint8_t*> ptr_scratch;  // blob-adapter node pointers
 
   Engine() {
-    ntab.resize(1 << 12);
+    seed = mix(reinterpret_cast<uint64_t>(this) ^ 0xa0761d6478bd642fULL,
+               static_cast<uint64_t>(
+                   std::chrono::steady_clock::now().time_since_epoch().count()) |
+                   1ULL);
+    // sized for a mainnet-shaped working set out of the gate (64k nodes,
+    // ~1M digests): early growth rehashes the whole table mid-batch
+    ntab.resize(1 << 14);
     for (auto& e : ntab) e.row = -1;
-    dtab.resize(1 << 13);
+    dtab.resize(1 << 17);
     for (auto& e : dtab) e.refid = -1;
   }
 
   void flush() {
     for (auto& e : ntab) e.row = -1;
     for (auto& e : dtab) e.refid = -1;
+    digest_arena.clear();
     arena.clear();
     own_refid.clear();
     child_refids.clear();
@@ -322,20 +351,25 @@ struct Engine {
   }
 
   int32_t find_refid(const uint8_t* d) const {
-    const uint64_t h = hash_digest(d);
+    const uint64_t h = hash_digest(d, seed);
     const uint64_t mask = dtab.size() - 1;
     uint64_t i = h & mask;
     while (true) {
       const DigestEntry& e = dtab[i];
       if (e.refid < 0) return -1;
-      if (e.hash == h && std::memcmp(e.digest, d, 32) == 0) return e.refid;
+      if (e.hash == h &&
+          std::memcmp(digest_arena.data() + 32 * e.refid, d, 32) == 0)
+        return e.refid;
       i = (i + 1) & mask;
     }
   }
 
   int32_t intern_digest(const uint8_t* d) {
+    return intern_digest_h(d, hash_digest(d, seed));
+  }
+
+  int32_t intern_digest_h(const uint8_t* d, uint64_t h) {
     if ((n_digests + 1) * 10 >= dtab.size() * 7) grow_dtab();
-    const uint64_t h = hash_digest(d);
     const uint64_t mask = dtab.size() - 1;
     uint64_t i = h & mask;
     while (true) {
@@ -343,10 +377,12 @@ struct Engine {
       if (e.refid < 0) {
         e.hash = h;
         e.refid = static_cast<int32_t>(n_digests++);
-        std::memcpy(e.digest, d, 32);
+        digest_arena.insert(digest_arena.end(), d, d + 32);
         return e.refid;
       }
-      if (e.hash == h && std::memcmp(e.digest, d, 32) == 0) return e.refid;
+      if (e.hash == h &&
+          std::memcmp(digest_arena.data() + 32 * e.refid, d, 32) == 0)
+        return e.refid;
       i = (i + 1) & mask;
     }
   }
@@ -370,15 +406,16 @@ uint64_t phant_engine_digests(void* h) {
   return static_cast<Engine*>(h)->n_digests;
 }
 
-// Hit-scan the batch. rows[i] = row id for known nodes, or -2 - k where k
-// indexes this batch's novel first-occurrence list (duplicates of one novel
-// byte-string share k). novel_idx (caller-sized >= n) receives the batch
-// index of each novel first occurrence. counts[0] = miss occurrences
-// (novel duplicates included — the "hits" complement), counts[1] = number
-// of novel first occurrences. Returns 0.
-int phant_engine_scan(void* h, const uint8_t* blob, const uint64_t* offs,
-                      const uint32_t* lens, uint64_t n, int64_t* rows,
-                      uint32_t* novel_idx, uint64_t* counts) {
+// Hit-scan the batch (node i at ptrs[i], lens[i] bytes). rows[i] = row id
+// for known nodes, or -2 - k where k indexes this batch's novel
+// first-occurrence list (duplicates of one novel byte-string share k).
+// novel_idx (caller-sized >= n) receives the batch index of each novel
+// first occurrence. counts[0] = miss occurrences (novel duplicates
+// included — the "hits" complement), counts[1] = number of novel first
+// occurrences. Returns 0.
+int phant_engine_scan_ptrs(void* h, const uint8_t* const* ptrs,
+                           const uint32_t* lens, uint64_t n, int64_t* rows,
+                           uint32_t* novel_idx, uint64_t* counts) {
   Engine& E = *static_cast<Engine*>(h);
   uint64_t miss = 0, novel = 0;
   // per-batch dup table: open addressing over novel first occurrences
@@ -387,9 +424,9 @@ int phant_engine_scan(void* h, const uint8_t* blob, const uint64_t* offs,
   E.novel_dup.assign(dcap, UINT32_MAX);
   const uint64_t dmask = dcap - 1;
   for (uint64_t i = 0; i < n; ++i) {
-    const uint8_t* p = blob + offs[i];
+    const uint8_t* p = ptrs[i];
     const uint32_t len = lens[i];
-    const uint64_t hsh = hash_bytes(p, len);
+    const uint64_t hsh = hash_bytes(p, len, E.seed);
     const int32_t row = E.find_node(p, len, hsh);
     if (row >= 0) {
       rows[i] = row;
@@ -402,7 +439,7 @@ int phant_engine_scan(void* h, const uint8_t* blob, const uint64_t* offs,
     while (E.novel_dup[j] != UINT32_MAX) {
       // the table stores novel-list indices; novel_idx[cand] = batch index
       const uint32_t cand = E.novel_dup[j];
-      const uint8_t* cp = blob + offs[novel_idx[cand]];
+      const uint8_t* cp = ptrs[novel_idx[cand]];
       const uint32_t cl = lens[novel_idx[cand]];
       if (cl == len && std::memcmp(cp, p, len) == 0) {
         found = cand;
@@ -428,31 +465,64 @@ int phant_engine_scan(void* h, const uint8_t* blob, const uint64_t* offs,
 // k, computed by the caller on the routed backend), intern their digests
 // and child references, fill the per-row link slots, and patch every
 // negative row in rows[0..n) to its real row id. Returns the base row.
+int64_t phant_engine_commit_ptrs(void* h, const uint8_t* const* ptrs,
+                                 const uint32_t* lens, uint64_t n,
+                                 int64_t* rows, const uint32_t* novel_idx,
+                                 uint64_t n_novel, const uint8_t* digests) {
+  Engine& E = *static_cast<Engine*>(h);
+  const int64_t base_row = static_cast<int64_t>(E.own_refid.size());
+  E.own_refid.resize(base_row + n_novel);
+  E.child_refids.resize((base_row + n_novel) * kChildSlots, -1);
+  size_t ref_off[kChildSlots];
+  uint64_t dh[kChildSlots + 1];
+  for (uint64_t k = 0; k < n_novel; ++k) {
+    const uint64_t i = novel_idx[k];
+    const uint8_t* p = ptrs[i];
+    const uint32_t len = lens[i];
+    E.insert_node(p, len, hash_bytes(p, len, E.seed),
+                  static_cast<int32_t>(base_row + k));
+    const int nref = node_refs(p, 0, len, ref_off);
+    // hash the node's own digest + every ref first and prefetch their
+    // probe slots — the ~17 intern probes per node are random-access
+    // bound, so overlapping their memory latency is the whole game
+    const uint64_t mask = E.dtab.size() - 1;
+    dh[0] = hash_digest(digests + 32 * k, E.seed);
+    for (int r = 0; r < nref; ++r)
+      dh[r + 1] = hash_digest(p + ref_off[r], E.seed);
+    for (int r = 0; r <= nref; ++r)
+      __builtin_prefetch(&E.dtab[dh[r] & mask]);
+    E.own_refid[base_row + k] = E.intern_digest_h(digests + 32 * k, dh[0]);
+    int32_t* slots = E.child_refids.data() + (base_row + k) * kChildSlots;
+    for (int r = 0; r < nref; ++r)
+      slots[r] = E.intern_digest_h(p + ref_off[r], dh[r + 1]);
+  }
+  for (uint64_t i = 0; i < n; ++i)
+    if (rows[i] < -1) rows[i] = base_row + (-2 - rows[i]);
+  return base_row;
+}
+
+// Contiguous-blob adapters (the ctypes/numpy interface): build the ptr
+// array and delegate.
+int phant_engine_scan(void* h, const uint8_t* blob, const uint64_t* offs,
+                      const uint32_t* lens, uint64_t n, int64_t* rows,
+                      uint32_t* novel_idx, uint64_t* counts) {
+  Engine& E = *static_cast<Engine*>(h);
+  E.ptr_scratch.resize(n);
+  for (uint64_t i = 0; i < n; ++i) E.ptr_scratch[i] = blob + offs[i];
+  return phant_engine_scan_ptrs(h, E.ptr_scratch.data(), lens, n, rows,
+                                novel_idx, counts);
+}
+
 int64_t phant_engine_commit(void* h, const uint8_t* blob,
                             const uint64_t* offs, const uint32_t* lens,
                             uint64_t n, int64_t* rows,
                             const uint32_t* novel_idx, uint64_t n_novel,
                             const uint8_t* digests) {
   Engine& E = *static_cast<Engine*>(h);
-  const int64_t base_row = static_cast<int64_t>(E.own_refid.size());
-  E.own_refid.resize(base_row + n_novel);
-  E.child_refids.resize((base_row + n_novel) * kChildSlots, -1);
-  size_t ref_off[kChildSlots];
-  for (uint64_t k = 0; k < n_novel; ++k) {
-    const uint64_t i = novel_idx[k];
-    const uint8_t* p = blob + offs[i];
-    const uint32_t len = lens[i];
-    E.insert_node(p, len, hash_bytes(p, len),
-                  static_cast<int32_t>(base_row + k));
-    E.own_refid[base_row + k] = E.intern_digest(digests + 32 * k);
-    const int nref = node_refs(blob, offs[i], offs[i] + len, ref_off);
-    int32_t* slots = E.child_refids.data() + (base_row + k) * kChildSlots;
-    for (int r = 0; r < nref; ++r)
-      slots[r] = E.intern_digest(blob + ref_off[r]);
-  }
-  for (uint64_t i = 0; i < n; ++i)
-    if (rows[i] < -1) rows[i] = base_row + (-2 - rows[i]);
-  return base_row;
+  E.ptr_scratch.resize(n);
+  for (uint64_t i = 0; i < n; ++i) E.ptr_scratch[i] = blob + offs[i];
+  return phant_engine_commit_ptrs(h, E.ptr_scratch.data(), lens, n, rows,
+                                  novel_idx, n_novel, digests);
 }
 
 // Per-block linked-multiproof verdicts. block b = rows[block_offs[b] ..

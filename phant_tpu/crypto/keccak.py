@@ -130,7 +130,7 @@ def keccak256_batch_cpu(payloads: Sequence[bytes]) -> List[bytes]:
     """Always the CPU path (native loop if available) — the baseline side
     of CPU-vs-TPU differential tests."""
     if _native is not None:
-        return _native.keccak256_batch(payloads)
+        return _native.keccak256_batch_fast(payloads)
     return [_keccak256_python(p) for p in payloads]
 
 

@@ -229,7 +229,7 @@ def execute_plan_host(plan: HashPlan) -> bytes:
             blob[off[k] : off[k] + ln[k]].tobytes() for k in range(len(off))
         ]
         if native is not None:
-            hashed = native.keccak256_batch(payloads)
+            hashed = native.keccak256_batch_fast(payloads)
         else:
             hashed = [keccak256(p) for p in payloads]
         digests[out_start : out_start + len(off)] = [

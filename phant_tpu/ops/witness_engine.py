@@ -92,17 +92,25 @@ class WitnessEngine:
         is the mechanism behind round-2's "never slower than cpu" demand:
         the flag routes by measured cost, not by hope."""
         # native C++ core (native/engine.cc): same interning + verdict
-        # semantics, ~5x the steady-state throughput (no Python dict
-        # re-hash of node bytes, no numpy sort in the join). The Python
-        # tables below stay as the fallback/differential twin
-        # (PHANT_ENGINE_NATIVE=0 forces it; tests run both).
+        # semantics, ~5-10x the steady-state throughput (no Python dict
+        # re-hash of node bytes, no numpy sort in the join). Preferred
+        # driver is the CPython extension (native/pyext.cc — feeds the
+        # core scattered PyBytes pointers, zero joins); the ctypes+numpy
+        # driver is the fallback (PHANT_ENGINE_EXT=0 forces it). The
+        # Python tables below stay as the final fallback/differential
+        # twin (PHANT_ENGINE_NATIVE=0 forces it; tests run all three).
         self._core = None
+        self._ext_core = None
         if os.environ.get("PHANT_ENGINE_NATIVE", "1") == "1":
-            from phant_tpu.utils.native import load_native
+            from phant_tpu.utils.native import load_engine_ext, load_native
 
-            native = load_native()
-            if native is not None:
-                self._core = native.new_engine()
+            ext = load_engine_ext()
+            if ext is not None:
+                self._ext_core = ext.Engine()
+            else:
+                native = load_native()
+                if native is not None:
+                    self._core = native.new_engine()
         # node bytes -> row (the memoization key: raw bytes, no hashing
         # needed to test membership)
         self._row_of_bytes: Dict[bytes, int] = {}
@@ -172,7 +180,7 @@ class WitnessEngine:
 
         native = load_native()
         if native is not None:
-            return list(native.keccak256_batch(nodes))
+            return list(native.keccak256_batch_fast(nodes))
         from phant_tpu.crypto.keccak import keccak256
 
         return [keccak256(n) for n in nodes]
@@ -421,6 +429,9 @@ class WitnessEngine:
         is that root or is hash-referenced by another node of block b
         (exactly witness_verify_fused's semantics; references are acyclic
         because a cycle would be a keccak collision)."""
+        with self._lock:
+            if self._ext_core is not None:
+                return self._verify_ext(witnesses)
         n_blocks = len(witnesses)
         all_nodes: List[bytes] = []
         counts = np.empty(n_blocks, np.int64)
@@ -431,6 +442,25 @@ class WitnessEngine:
             if self._core is not None:
                 return self._verify_native(witnesses, all_nodes, counts, n_blocks)
             return self._verify_interned(witnesses, all_nodes, counts, n_blocks)
+
+    def _verify_ext(self, witnesses):
+        """Two-call scan/finish protocol against the CPython extension
+        driver — no batch assembly on the Python side at all. Hashing of
+        novel nodes stays here so the backend route applies identically."""
+        st = self._ext_core
+        novel, miss, total = st.scan(witnesses)
+        if novel:
+            if st.nodes() + len(novel) > self._max_nodes and st.nodes():
+                self.stats["evictions"] += 1
+                st.flush()
+                novel, miss, total = st.scan(witnesses)
+            digests = self._hash_batch(novel)
+            self.stats["hashed"] += len(novel)
+            verdict = st.finish(b"".join(digests))
+        else:
+            verdict = st.finish(None)
+        self.stats["hits"] += total - miss
+        return np.frombuffer(verdict, np.uint8).astype(bool)
 
     def _verify_native(self, witnesses, all_nodes, counts, n_blocks):
         """Scan/hash/commit/verdict against the C++ core. The hashing of
@@ -506,7 +536,11 @@ class WitnessEngine:
         st = dict(self.stats)
         seen = st.get("hashed", 0) + st.get("hits", 0)
         st["hit_rate"] = round(st.get("hits", 0) / seen, 4) if seen else 0.0
-        if self._core is not None:
+        if self._ext_core is not None:
+            st["interned_nodes"] = self._ext_core.nodes()
+            st["interned_digests"] = self._ext_core.digests()
+            st["core"] = "native-ext"
+        elif self._core is not None:
             st["interned_nodes"] = self._core.nodes
             st["interned_digests"] = self._core.digests
             st["core"] = "native"

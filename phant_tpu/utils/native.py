@@ -26,9 +26,14 @@ _load_failed = False
 
 
 def _sources() -> List[Path]:
-    # selftest.cc is the standalone sanitizer harness (`make sanitize`),
-    # not part of the shared library
-    return sorted(p for p in _NATIVE_DIR.glob("*.cc") if p.name != "selftest.cc")
+    # selftest.cc is the standalone sanitizer harness (`make sanitize`);
+    # pyext.cc is the CPython extension (its own .so, load_engine_ext) —
+    # neither belongs in the ctypes shared library
+    return sorted(
+        p
+        for p in _NATIVE_DIR.glob("*.cc")
+        if p.name not in ("selftest.cc", "pyext.cc")
+    )
 
 
 def _needs_rebuild() -> bool:
@@ -89,6 +94,12 @@ class NativeLib:
             ctypes.c_char_p,
         ]
         lib.phant_keccak256_batch.restype = None
+        self.has_fast_keccak = hasattr(lib, "phant_keccak256_batch_fast")
+        if self.has_fast_keccak:
+            lib.phant_keccak256_batch_fast.argtypes = (
+                lib.phant_keccak256_batch.argtypes
+            )
+            lib.phant_keccak256_batch_fast.restype = None
         lib.phant_pack_keccak.argtypes = [
             ctypes.c_char_p,
             ctypes.POINTER(ctypes.c_uint64),
@@ -248,12 +259,27 @@ class NativeLib:
         return EngineCore(self._lib) if self.has_engine else None
 
     def keccak256_batch(self, payloads: Sequence[bytes]) -> List[bytes]:
+        """Strictly scalar batch — the reference-equivalent baseline
+        (the reference hashes one node at a time, crypto/hasher.zig:4-17)."""
+        return self._batch_hash(payloads, self._lib.phant_keccak256_batch)
+
+    def keccak256_batch_fast(self, payloads: Sequence[bytes]) -> List[bytes]:
+        """The framework's own hashing path: 8-way AVX-512 multi-buffer on
+        capable x86 hosts, bit-identical scalar dispatch elsewhere."""
+        fn = (
+            self._lib.phant_keccak256_batch_fast
+            if self.has_fast_keccak
+            else self._lib.phant_keccak256_batch
+        )
+        return self._batch_hash(payloads, fn)
+
+    def _batch_hash(self, payloads: Sequence[bytes], fn) -> List[bytes]:
         n = len(payloads)
         if n == 0:
             return []
         blob, offsets, lens = self._layout(payloads)
         out = ctypes.create_string_buffer(32 * n)
-        self._lib.phant_keccak256_batch(blob, offsets, lens, n, out)
+        fn(blob, offsets, lens, n, out)
         raw = out.raw
         return [raw[32 * i : 32 * i + 32] for i in range(n)]
 
@@ -339,6 +365,60 @@ class EngineCore:
             ok.ctypes.data_as(ctypes.c_void_p),
         )
         return ok.astype(bool)
+
+
+_EXT_PATH = _BUILD_DIR / "phant_engine_ext.so"
+_ext_lock = threading.Lock()
+_ext_mod = None
+_ext_failed = False
+
+
+def load_engine_ext():
+    """Build (if stale) and import the CPython extension driver for the
+    witness-engine core (native/pyext.cc + engine.cc). Returns the module
+    (with its `Engine` type) or None; PHANT_ENGINE_EXT=0 disables it (the
+    ctypes core then serves, PHANT_ENGINE_NATIVE=0 the Python twin)."""
+    global _ext_mod, _ext_failed
+    # env checks FIRST: the kill switches must keep working after the
+    # module has been cached in-process (the test matrix's "ctypes" run
+    # relies on PHANT_ENGINE_EXT=0 actually forcing the fallback)
+    if _ext_failed or os.environ.get("PHANT_NO_NATIVE"):
+        return None
+    if os.environ.get("PHANT_ENGINE_EXT", "1") != "1":
+        return None
+    if _ext_mod is not None:
+        return _ext_mod
+    with _ext_lock:
+        if _ext_mod is not None:
+            return _ext_mod
+        try:
+            import sysconfig
+
+            srcs = [_NATIVE_DIR / "pyext.cc", _NATIVE_DIR / "engine.cc"]
+            _BUILD_DIR.mkdir(exist_ok=True)
+            if not _EXT_PATH.exists() or any(
+                s.stat().st_mtime > _EXT_PATH.stat().st_mtime for s in srcs
+            ):
+                cmd = [
+                    "g++", "-O3", *_arch_flags(), "-std=c++20", "-shared",
+                    "-fPIC", "-fno-rtti",
+                    f"-I{sysconfig.get_paths()['include']}",
+                    *(str(s) for s in srcs),
+                    "-o", str(_EXT_PATH),
+                ]
+                subprocess.run(cmd, check=True, capture_output=True)
+            import importlib.util
+            from importlib.machinery import ExtensionFileLoader
+
+            loader = ExtensionFileLoader("phant_engine_ext", str(_EXT_PATH))
+            spec = importlib.util.spec_from_loader("phant_engine_ext", loader)
+            mod = importlib.util.module_from_spec(spec)
+            spec.loader.exec_module(mod)
+            _ext_mod = mod
+        except Exception:
+            _ext_failed = True
+            return None
+    return _ext_mod
 
 
 def load_native() -> Optional[NativeLib]:

@@ -15,10 +15,27 @@ import sys
 import pytest
 
 
+def _bench_env():
+    """os.environ minus the knobs that must not leak into the bench
+    subprocess: the device pool pointer, and conftest's in-process kernel
+    switches (PHANT_TPU_MIN_ECRECOVER=1 would route the replay's sender
+    recovery through the GLV device ladder, whose XLA-CPU compile alone
+    blows the watchdog — the bench's PRODUCTION routing is exactly what
+    this contract test is supposed to exercise)."""
+    env = dict(os.environ)
+    for knob in (
+        "PALLAS_AXON_POOL_IPS",
+        "PHANT_TPU_FORCE_TRIE",
+        "PHANT_TPU_MIN_TRIE",
+        "PHANT_TPU_MIN_ECRECOVER",
+    ):
+        env.pop(knob, None)
+    return env
+
+
 @pytest.mark.slow
 def test_bench_prints_one_json_line_with_schema(tmp_path):
-    env = dict(os.environ)
-    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env = _bench_env()
     env.update(
         JAX_PLATFORMS="cpu",
         # isolated single-writer compile cache: conftest globally disables
@@ -70,8 +87,7 @@ def test_bench_prints_one_json_line_with_schema(tmp_path):
 def test_bench_global_deadline_always_prints_json(tmp_path):
     """A hung tunnel must still yield the driver a JSON line: force the
     global deadline to fire almost immediately and check the fallback."""
-    env = dict(os.environ)
-    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env = _bench_env()
     env.update(
         JAX_PLATFORMS="cpu",
         PHANT_NO_COMPILE_CACHE="0",

@@ -36,14 +36,24 @@ def _witness(trie, keys, picks, rng):
     return list(nodes.keys())
 
 
-@pytest.fixture(params=["native", "python"], autouse=True)
+@pytest.fixture(params=["ext", "ctypes", "python"], autouse=True)
 def engine_core(request, monkeypatch):
-    """Run every test in this module against BOTH engine cores: the C++
-    one (native/engine.cc) and the pure-Python twin it must match."""
+    """Run every test in this module against ALL engine cores: the C++
+    one (native/engine.cc) behind its two drivers — the CPython extension
+    (native/pyext.cc) and the ctypes+numpy fallback — and the pure-Python
+    twin they must match."""
     monkeypatch.setenv(
-        "PHANT_ENGINE_NATIVE", "1" if request.param == "native" else "0"
+        "PHANT_ENGINE_NATIVE", "0" if request.param == "python" else "1"
     )
-    if request.param == "native":
+    monkeypatch.setenv(
+        "PHANT_ENGINE_EXT", "1" if request.param == "ext" else "0"
+    )
+    if request.param == "ext":
+        from phant_tpu.utils.native import load_engine_ext
+
+        if load_engine_ext() is None:
+            pytest.skip("engine extension unavailable")
+    elif request.param == "ctypes":
         from phant_tpu.utils.native import load_native
 
         lib = load_native()
@@ -299,8 +309,8 @@ def test_native_vs_python_core_differential(engine_core, monkeypatch):
     nodes, deep-embedded ref inflation (>17 refs), unknown roots,
     cross-batch memoization and eviction. This is the soundness contract
     of swapping the core."""
-    if engine_core != "native":
-        pytest.skip("constructs both cores itself; one run suffices")
+    if engine_core == "python":
+        pytest.skip("constructs both cores itself (native param vs python)")
     from phant_tpu.utils.native import load_native
 
     lib = load_native()
@@ -338,10 +348,10 @@ def test_native_vs_python_core_differential(engine_core, monkeypatch):
 
     monkeypatch.setenv("PHANT_ENGINE_NATIVE", "1")
     eng_n = WitnessEngine(max_nodes=200)  # small cap: exercise eviction
-    assert eng_n._core is not None
+    assert eng_n._core is not None or eng_n._ext_core is not None
     monkeypatch.setenv("PHANT_ENGINE_NATIVE", "0")
     eng_p = WitnessEngine(max_nodes=200)
-    assert eng_p._core is None
+    assert eng_p._core is None and eng_p._ext_core is None
 
     for wit in batches:
         out_n = eng_n.verify_batch(wit)
