@@ -206,7 +206,10 @@ struct PhantTxContext {
   uint8_t gas_price[32];
   uint8_t prev_randao[32];
   uint8_t base_fee[32];
-  // EVM revision: 0 = Shanghai, 1 = Cancun (the reference hardcodes
+  // EVM revision: 0 = Shanghai, 1 = Cancun, 2 = Prague — Cancun opcode
+  // gates check `revision >= 1` so Prague inherits them; EIP-7702
+  // delegation resolves host-side in the shared _call_inner, so this core
+  // needs no Prague-specific opcodes. (The reference hardcodes
   // EVMC_SHANGHAI, src/blockchain/vm.zig:472; this core fork-dispatches)
   uint64_t revision;
   uint8_t blob_base_fee[32];          // EIP-7516
@@ -276,6 +279,11 @@ struct PhantHost {
   // this is the equivalent debugging surface, actually wired up.
   void (*trace)(void*, uint64_t pc, int32_t op, int64_t gas, int32_t depth,
                 int32_t stack_size);
+  // EIP-7702 (Prague): extra CALL-family charge when the code target is a
+  // delegated account — warms the delegate host-side and returns its
+  // warm/cold access cost (0 when not delegated / pre-Prague). Appended
+  // last so older vtable layouts stay a strict prefix.
+  int64_t (*delegate_access_cost)(void*, const uint8_t addr[20]);
 };
 
 }  // extern "C"
@@ -1229,6 +1237,9 @@ Halt Interp::run() {
           return Halt::kFail;
         int warm = host->access_account(host->ctx, addr);
         GAS(warm ? kWarmAccount : kColdAccount);
+        // EIP-7702: a delegated code target charges the delegate's
+        // warm/cold access to THIS instruction, before the 63/64 split
+        GAS(host->delegate_access_cost(host->ctx, addr));
         if (!expand(in_off, in_size)) return Halt::kFail;
         if (!expand(ret_off, ret_size)) return Halt::kFail;
         int64_t extra = 0;

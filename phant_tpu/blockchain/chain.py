@@ -30,9 +30,11 @@ from phant_tpu.types.receipt import Receipt, logs_bloom
 from phant_tpu.types.transaction import (
     BlobTx,
     FeeMarketTx,
+    SetCodeTx,
     Transaction,
     VERSIONED_HASH_VERSION_KZG,
     access_list_of,
+    authorization_list_of,
     blob_gas_of,
     effective_gas_price,
     max_fee_per_gas,
@@ -258,6 +260,16 @@ class Blockchain:
             return name in ("cancun", "prague", "osaka")
         return header.excess_blob_gas is not None
 
+    def prague_active(self, header: BlockHeader) -> bool:
+        """Prague dispatch (EIP-7702 set-code txs, EIP-7691 blob schedule,
+        EIP-2935 ring). Config-less chains (fixtures/synthetic) activate
+        Prague together with Cancun's self-describing blob fields so the
+        differential suites can exercise type-4 txs without a chainspec."""
+        if self.config is not None:
+            name = self.config.fork_at(header.block_number, header.timestamp)
+            return name in ("prague", "osaka")
+        return header.excess_blob_gas is not None
+
     def blob_schedule(self, header: BlockHeader) -> tuple:
         """(max_blob_gas, target_blob_gas, fee_update_fraction) for this
         block — EIP-7691 raised all three at Prague. Config-less chains
@@ -430,7 +442,7 @@ class Blockchain:
         if tx.gas_limit > gas_available:
             raise BlockError("tx gas limit exceeds available block gas")
         base_fee = header.base_fee_per_gas or 0
-        if isinstance(tx, (FeeMarketTx, BlobTx)):
+        if isinstance(tx, (FeeMarketTx, BlobTx, SetCodeTx)):
             if tx.max_fee_per_gas < tx.max_priority_fee_per_gas:
                 raise BlockError("max fee below priority fee")
             if tx.max_fee_per_gas < base_fee:
@@ -438,6 +450,15 @@ class Blockchain:
         else:
             if tx.gas_price < base_fee:
                 raise BlockError("gas price below base fee")
+
+        if isinstance(tx, SetCodeTx):
+            # EIP-7702 validity (no reference analog — type 4 postdates it)
+            if not self.prague_active(header):
+                raise BlockError("set-code tx before prague")
+            if tx.to is None:
+                raise BlockError("set-code tx cannot create")
+            if not tx.authorization_list:
+                raise BlockError("set-code tx without authorizations")
 
         blob_fee = 0
         if isinstance(tx, BlobTx):
@@ -464,7 +485,11 @@ class Blockchain:
         if is_create and len(tx.data) > G.MAX_INITCODE_SIZE:
             raise BlockError("initcode exceeds EIP-3860 limit")
         intrinsic = G.intrinsic_gas(
-            tx.data, is_create, access_list_of(tx), len(tx.data) if is_create else 0
+            tx.data,
+            is_create,
+            access_list_of(tx),
+            len(tx.data) if is_create else 0,
+            n_authorizations=len(authorization_list_of(tx)),
         )
         if intrinsic > tx.gas_limit:
             raise BlockError("intrinsic gas exceeds limit")
@@ -474,13 +499,58 @@ class Blockchain:
         if nonce != tx.nonce:
             raise BlockError(f"nonce mismatch: tx {tx.nonce}, account {nonce}")
         if sender_acct is not None and sender_acct.code:
-            raise BlockError("sender is not EOA (EIP-3607)")
+            # EIP-3607, as amended by EIP-7702: an EOA carrying a delegation
+            # designator may still originate transactions
+            if not G.is_delegation_designator(sender_acct.code):
+                raise BlockError("sender is not EOA (EIP-3607)")
         max_cost = tx.gas_limit * max_fee_per_gas(tx) + tx.value + blob_fee
         balance = sender_acct.balance if sender_acct else 0
         if balance < max_cost:
             raise BlockError("insufficient sender balance for gas + value")
 
     # ------------------------------------------------------------------
+
+    def _apply_authorizations(self, tx: Transaction, state) -> int:
+        """EIP-7702 per-tuple processing; returns the gas-refund credit.
+
+        For each authorization: screen chain id (0 or ours) and nonce
+        ceiling, recover the authority from its signature over
+        keccak(0x05 ‖ rlp([chain_id, address, nonce])), warm the authority,
+        and — if its code is empty or already a delegation and its nonce
+        matches — install 0xef0100‖address (or clear it for the zero
+        address) and bump the authority nonce. Existing authorities earn
+        the PER_EMPTY_ACCOUNT_COST − PER_AUTH_BASE_COST refund. Any
+        screening failure skips the TUPLE, never the tx."""
+        from phant_tpu.signer.signer import recover_authority
+
+        refund = 0
+        for auth in authorization_list_of(tx):
+            if auth.chain_id not in (0, self.chain_id):
+                continue
+            if auth.nonce >= 2**64 - 1:
+                continue
+            authority = recover_authority(auth)
+            if authority is None:
+                continue
+            # the authority is warmed even when a later check skips the
+            # tuple (EIP-7702: added to accessed_addresses regardless)
+            state.access_address(authority)
+            acct = state.get_account(authority)
+            code = acct.code if acct else b""
+            if code and not G.is_delegation_designator(code):
+                continue  # a real contract cannot be delegated
+            nonce = acct.nonce if acct else 0
+            if nonce != auth.nonce:
+                continue
+            if not state.is_empty(authority):
+                refund += G.PER_EMPTY_ACCOUNT_COST - G.PER_AUTH_BASE_COST
+            if auth.address == b"\x00" * 20:
+                state.set_code(authority, b"")  # clear the delegation
+            else:
+                state.set_code(authority, G.DELEGATION_PREFIX + auth.address)
+            state.increment_nonce(authority)
+            state.touch(authority)
+        return refund
 
     def process_transaction(
         self,
@@ -510,8 +580,18 @@ class Blockchain:
             )
         blob_fee_rate = blob_base_fee
 
-        from phant_tpu.evm.message import REVISION_CANCUN, REVISION_SHANGHAI
+        from phant_tpu.evm.message import (
+            REVISION_CANCUN,
+            REVISION_PRAGUE,
+            REVISION_SHANGHAI,
+        )
 
+        if self.prague_active(header):
+            revision = REVISION_PRAGUE
+        elif cancun:
+            revision = REVISION_CANCUN
+        else:
+            revision = REVISION_SHANGHAI
         env = Environment(
             state=state,
             origin=sender,
@@ -524,7 +604,7 @@ class Blockchain:
             base_fee=base_fee,
             chain_id=self.chain_id,
             block_hash_fn=self.fork.get_block_hash,
-            revision=REVISION_CANCUN if cancun else REVISION_SHANGHAI,
+            revision=revision,
             blob_hashes=(
                 tx.blob_versioned_hashes if isinstance(tx, BlobTx) else ()
             ),
@@ -555,8 +635,25 @@ class Blockchain:
         intrinsic = G.intrinsic_gas(
             tx.data, tx.to is None, access_list_of(tx),
             len(tx.data) if tx.to is None else 0,
+            n_authorizations=len(authorization_list_of(tx)),
         )
         exec_gas = tx.gas_limit - intrinsic
+
+        # EIP-7702 authorization processing: after the sender nonce bump,
+        # before execution. Tuple-level failures skip the tuple (the tx
+        # stays valid); auth refunds survive a reverted execution because
+        # the delegations themselves do (they are tx-level state, not part
+        # of the message frame's journal scope).
+        auth_refund = self._apply_authorizations(tx, state)
+
+        if revision >= REVISION_PRAGUE and tx.to is not None:
+            # EIP-7702: a delegated destination's delegate is warmed for
+            # free at the tx top level (nested CALLs pay for it at the
+            # calling instruction instead). After auth processing — this
+            # very tx may have just installed the delegation on tx.to.
+            to_code = state.get_code(tx.to)
+            if G.is_delegation_designator(to_code):
+                state.access_address(G.delegation_target(to_code))
 
         evm = Evm(env)
         msg = Message(
@@ -568,12 +665,12 @@ class Blockchain:
         )
         result = evm.execute_message(msg)
 
-        # refunds (reference: blockchain.zig:312-331; EIP-3529 quotient 5)
+        # refunds (reference: blockchain.zig:312-331; EIP-3529 quotient 5).
+        # EIP-7702 auth refunds apply even when execution reverted — the
+        # delegations they correspond to were still installed
         gas_used = tx.gas_limit - result.gas_left
-        if result.success:
-            refund = min(state.refund, gas_used // G.REFUND_QUOTIENT)
-        else:
-            refund = 0
+        counter = (state.refund if result.success else 0) + auth_refund
+        refund = min(counter, gas_used // G.REFUND_QUOTIENT)
         gas_used -= refund
         state.add_balance(sender, (tx.gas_limit - gas_used) * gas_price)
 

@@ -69,9 +69,17 @@ def copy_cost(length: int) -> int:
     return COPY_WORD_GAS * ((length + 31) // 32)
 
 
-def intrinsic_gas(data: bytes, is_create: bool, access_list, init_code_len: int = 0) -> int:
+def intrinsic_gas(
+    data: bytes,
+    is_create: bool,
+    access_list,
+    init_code_len: int = 0,
+    n_authorizations: int = 0,
+) -> int:
     """Intrinsic cost before execution (reference:
-    src/blockchain/blockchain.zig:355-377, incl. EIP-3860 word cost)."""
+    src/blockchain/blockchain.zig:355-377, incl. EIP-3860 word cost;
+    EIP-7702 charges PER_EMPTY_ACCOUNT_COST per authorization tuple up
+    front, refunded down to PER_AUTH_BASE_COST for existing authorities)."""
     gas = TX_BASE_COST
     for byte in data:
         gas += TX_DATA_COST_ZERO if byte == 0 else TX_DATA_COST_NONZERO
@@ -81,6 +89,7 @@ def intrinsic_gas(data: bytes, is_create: bool, access_list, init_code_len: int 
     for _, keys in access_list:
         gas += TX_ACCESS_LIST_ADDRESS_COST
         gas += TX_ACCESS_LIST_STORAGE_KEY_COST * len(keys)
+    gas += PER_EMPTY_ACCOUNT_COST * n_authorizations
     return gas
 
 # --- Cancun (EIP-4844 / 1153 / 5656 / 7516; beyond the reference's
@@ -146,3 +155,20 @@ def calc_excess_blob_gas(
     if total < target:
         return 0
     return total - target
+
+
+# --- Prague EIP-7702 set-code transactions ---
+PER_AUTH_BASE_COST = 12_500  # floor cost per authorization tuple
+PER_EMPTY_ACCOUNT_COST = 25_000  # charged up front per tuple (intrinsic)
+DELEGATION_PREFIX = b"\xef\x01\x00"  # designator: 0xef0100 || address
+DELEGATION_MARKER = b"\xef\x01"  # what EXTCODE* see on a delegated account
+
+
+def is_delegation_designator(code: bytes) -> bool:
+    """The consensus-critical EIP-7702 designator predicate — the ONE
+    definition both EVM backends and the tx-processing layer share."""
+    return len(code) == 23 and code[:3] == DELEGATION_PREFIX
+
+
+def delegation_target(code: bytes) -> bytes:
+    return bytes(code[3:23])

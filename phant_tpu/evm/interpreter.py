@@ -26,6 +26,7 @@ from phant_tpu.evm.message import (
     ExecResult,
     Message,
     REVISION_CANCUN,
+    REVISION_PRAGUE,
 )
 from phant_tpu.evm.precompiles import PRECOMPILES, precompile_addresses
 from phant_tpu.types.receipt import Log
@@ -185,6 +186,23 @@ class Evm:
             return result
 
         code = state.get_code(code_addr)
+        # EIP-7702 delegation: 0xef0100‖address executes the delegate's
+        # code in the account's own context. Resolved ONE level (a chain
+        # of designators executes the raw designator bytes, which halt on
+        # 0xEF). The gas for the delegate's access is the CALLER's cost
+        # (delegation_access_cost in the CALL family / free warm-add at
+        # the tx top level) — resolution here is charge-free. This is the
+        # single code-fetch point for both backends (the native core's
+        # nested calls re-enter here via the host `call` callback), so
+        # delegation behaves identically everywhere.
+        if self.env.revision >= REVISION_PRAGUE and G.is_delegation_designator(
+            code
+        ):
+            delegate = G.delegation_target(code)
+            state.access_address(delegate)  # idempotent (already warmed)
+            delegated = state.get_code(delegate)
+            if not G.is_delegation_designator(delegated):
+                code = delegated
         if not code:
             return ExecResult(True, msg.gas)
 
@@ -613,12 +631,38 @@ def _gasprice(evm, frame):
     frame.push(evm.env.gas_price)
 
 
+
+def _visible_code(evm, addr: bytes) -> bytes:
+    """Code as seen by the EXTCODE* instructions: a delegated account
+    (EIP-7702 designator 0xef0100‖address) exposes only the 2-byte marker
+    0xef01 — the delegate address is deliberately opaque to contracts."""
+    code = evm.state.get_code(addr)
+    if evm.env.revision >= REVISION_PRAGUE and G.is_delegation_designator(code):
+        return G.DELEGATION_MARKER
+    return code
+
+
+def delegation_access_cost(evm, code_addr: bytes) -> int:
+    """EIP-7702 surcharge for calling through a delegated account: warms
+    the delegate and returns its warm/cold access cost (0 when the target
+    is not delegated or pre-Prague). Shared by both backends' CALL-family
+    gas accounting — the python opcodes directly, the native core via the
+    delegate_access_cost host callback."""
+    if evm.env.revision < REVISION_PRAGUE:
+        return 0
+    code = evm.state.get_code(code_addr)
+    if not G.is_delegation_designator(code):
+        return 0
+    warm = evm.state.access_address(G.delegation_target(code))
+    return G.WARM_ACCOUNT_ACCESS if warm else G.COLD_ACCOUNT_ACCESS
+
+
 @op(0x3B)
 def _extcodesize(evm, frame):
     addr = _int_to_addr(frame.pop())
     warm = evm.state.access_address(addr)
     frame.use_gas(G.WARM_ACCOUNT_ACCESS if warm else G.COLD_ACCOUNT_ACCESS)
-    frame.push(len(evm.state.get_code(addr)))
+    frame.push(len(_visible_code(evm, addr)))
 
 
 @op(0x3C)
@@ -628,7 +672,7 @@ def _extcodecopy(evm, frame):
     warm = evm.state.access_address(addr)
     frame.use_gas((G.WARM_ACCOUNT_ACCESS if warm else G.COLD_ACCOUNT_ACCESS) + G.copy_cost(size))
     frame.expand_memory(dest, size)
-    ext = evm.state.get_code(addr)
+    ext = _visible_code(evm, addr)
     data = ext[src : src + size] if src < len(ext) else b""
     frame.mwrite(dest, data.ljust(size, b"\x00"))
 
@@ -656,8 +700,12 @@ def _extcodehash(evm, frame):
     if evm.state.is_empty(addr):
         frame.push(0)
     else:
+        code = _visible_code(evm, addr)
         acct = evm.state.get_account(addr)
-        frame.push(int.from_bytes(acct.code_hash(), "big"))
+        if code == G.DELEGATION_MARKER:  # delegated: hash of the marker
+            frame.push(int.from_bytes(keccak256(code), "big"))
+        else:
+            frame.push(int.from_bytes(acct.code_hash(), "big"))
 
 
 # ---- 0x40s: block ----
@@ -993,6 +1041,9 @@ def _call_family(evm, frame, kind: str):
     warm = evm.state.access_address(addr)
     access_cost = G.WARM_ACCOUNT_ACCESS if warm else G.COLD_ACCOUNT_ACCESS
     frame.use_gas(access_cost)
+    # EIP-7702: a delegated code target charges the delegate's warm/cold
+    # access to THIS instruction (caller side, before the 63/64 split)
+    frame.use_gas(delegation_access_cost(evm, addr))
     frame.expand_memory(in_off, in_size)
     frame.expand_memory(ret_off, ret_size)
 

@@ -17,6 +17,7 @@ if TYPE_CHECKING:
 # src/blockchain/vm.zig:472; this framework dispatches per fork)
 REVISION_SHANGHAI = 0
 REVISION_CANCUN = 1
+REVISION_PRAGUE = 2
 
 
 @dataclass

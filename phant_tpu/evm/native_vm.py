@@ -17,6 +17,7 @@ from __future__ import annotations
 import ctypes as ct
 from typing import Optional
 
+from phant_tpu.evm import gas as G
 from phant_tpu.evm.message import ExecResult, Message
 from phant_tpu.types.receipt import Log
 
@@ -131,6 +132,11 @@ _CB = {
         None, ct.c_void_p, ct.c_uint64, ct.c_int32, ct.c_int64, ct.c_int32,
         ct.c_int32,
     ),
+    # EIP-7702 (Prague): extra CALL-family charge for delegated code
+    # targets; appended LAST to keep older vtable layouts a strict prefix
+    "delegate_access_cost": ct.CFUNCTYPE(
+        ct.c_int64, ct.c_void_p, ct.POINTER(ct.c_uint8)
+    ),
 }
 
 
@@ -218,7 +224,13 @@ class NativeSession:
         self.host.ctx = None
         # int-returning callbacks need an explicit safe default; void ones
         # return None regardless
-        int_cbs = {"access_account", "access_storage", "get_code_size", "is_empty"}
+        int_cbs = {
+            "access_account",
+            "access_storage",
+            "get_code_size",
+            "is_empty",
+            "delegate_access_cost",
+        }
         for name in _CB:
             if name == "trace" and getattr(evm, "tracer", None) is None:
                 # leave the vtable slot NULL: the C loop skips tracing
@@ -266,20 +278,37 @@ class NativeSession:
         _write32(out, self.state.get_balance(_bytes20(addr)))
 
     def _cb_get_code_size(self, _ctx, addr) -> int:
-        return len(self.state.get_code(_bytes20(addr)))
+        from phant_tpu.evm.interpreter import _visible_code
+
+        return len(_visible_code(self.evm, _bytes20(addr)))
 
     def _cb_copy_code(self, _ctx, addr, offset, out, size) -> None:
-        code = self.state.get_code(_bytes20(addr))
+        from phant_tpu.evm.interpreter import _visible_code
+
+        code = _visible_code(self.evm, _bytes20(addr))
         chunk = code[offset : offset + size]
         if chunk:
             ct.memmove(out, chunk, len(chunk))
 
     def _cb_get_code_hash(self, _ctx, addr, out) -> None:
-        acct = self.state.get_account(_bytes20(addr))
+        from phant_tpu.crypto.keccak import keccak256
+        from phant_tpu.evm.interpreter import _visible_code
+
+        address = _bytes20(addr)
+        acct = self.state.get_account(address)
         if acct is None:
             ct.memmove(out, b"\x00" * 32, 32)
+            return
+        code = _visible_code(self.evm, address)
+        if code == G.DELEGATION_MARKER:  # delegated: hash of the marker
+            ct.memmove(out, keccak256(code), 32)
         else:
             ct.memmove(out, acct.code_hash(), 32)
+
+    def _cb_delegate_access_cost(self, _ctx, addr) -> int:
+        from phant_tpu.evm.interpreter import delegation_access_cost
+
+        return delegation_access_cost(self.evm, _bytes20(addr))
 
     def _cb_is_empty(self, _ctx, addr) -> int:
         return 1 if self.state.is_empty(_bytes20(addr)) else 0

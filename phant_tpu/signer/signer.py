@@ -19,6 +19,7 @@ from phant_tpu.types.transaction import (
     BlobTx,
     FeeMarketTx,
     LegacyTx,
+    SetCodeTx,
     Transaction,
     _encode_access_list,
 )
@@ -89,7 +90,79 @@ def signing_hash(tx: Transaction, chain_id: int) -> bytes:
             [h for h in tx.blob_versioned_hashes],
         ]
         return keccak256(b"\x03" + rlp.encode(payload))
+    if isinstance(tx, SetCodeTx):
+        # EIP-7702: 0x04 ‖ rlp([..., access_list, authorization_list])
+        payload = [
+            rlp.encode_uint(tx.chain_id_val),
+            rlp.encode_uint(tx.nonce),
+            rlp.encode_uint(tx.max_priority_fee_per_gas),
+            rlp.encode_uint(tx.max_fee_per_gas),
+            rlp.encode_uint(tx.gas_limit),
+            tx.to if tx.to is not None else b"",
+            rlp.encode_uint(tx.value),
+            tx.data,
+            _encode_access_list(tx.access_list),
+            [a.fields() for a in tx.authorization_list],
+        ]
+        return keccak256(b"\x04" + rlp.encode(payload))
     raise TypeError(f"unknown tx type {type(tx).__name__}")
+
+
+AUTH_MAGIC = b"\x05"  # EIP-7702 authorization signing-domain separator
+
+
+def authorization_signing_hash(auth) -> bytes:
+    """keccak(0x05 ‖ rlp([chain_id, address, nonce])) — the message an
+    EIP-7702 authority signs (EIP-7702; the MAGIC byte keeps it disjoint
+    from every EIP-2718 tx type)."""
+    return keccak256(
+        AUTH_MAGIC
+        + rlp.encode(
+            [
+                rlp.encode_uint(auth.chain_id),
+                auth.address,
+                rlp.encode_uint(auth.nonce),
+            ]
+        )
+    )
+
+
+def sign_authorization(
+    chain_id: int, address: bytes, nonce: int, private_key: int
+):
+    """Test/tooling helper: a signed EIP-7702 authorization tuple."""
+    from phant_tpu.types.transaction import Authorization
+
+    unsigned = Authorization(
+        chain_id=chain_id, address=address, nonce=nonce, y_parity=0, r=0, s=0
+    )
+    r, s, y_parity = secp256k1.sign(
+        authorization_signing_hash(unsigned), private_key
+    )
+    return Authorization(
+        chain_id=chain_id, address=address, nonce=nonce,
+        y_parity=y_parity, r=r, s=s,
+    )
+
+
+def recover_authority(auth) -> Optional[bytes]:
+    """The 20-byte authority that signed an EIP-7702 authorization tuple,
+    or None when the signature is invalid. Validation per EIP-7702: low-s
+    malleability and y_parity ∈ {0,1} (chain-id/nonce screening is the
+    caller's per-tuple processing, chain.py)."""
+    if auth.y_parity not in (0, 1):
+        return None
+    if not (0 < auth.r < secp256k1.N):
+        return None
+    if not (0 < auth.s <= secp256k1.N // 2):
+        return None
+    try:
+        pub = secp256k1.recover_pubkey(
+            authorization_signing_hash(auth), auth.r, auth.s, auth.y_parity
+        )
+    except SignatureError:
+        return None
+    return address_from_pubkey(pub)
 
 
 def recovery_fields(tx: Transaction, chain_id: int) -> Tuple[int, int, int]:

@@ -22,6 +22,7 @@ TX_TYPE_LEGACY = 0x00
 TX_TYPE_ACCESS_LIST = 0x01
 TX_TYPE_FEE_MARKET = 0x02
 TX_TYPE_BLOB = 0x03
+TX_TYPE_SET_CODE = 0x04
 
 # EIP-4844 blob constants (consensus-critical); GAS_PER_BLOB's single
 # source of truth is the gas schedule (phant_tpu/evm/gas.py)
@@ -308,7 +309,124 @@ class BlobTx:
         )
 
 
-Transaction = Union[LegacyTx, AccessListTx, FeeMarketTx, BlobTx]
+@dataclass(frozen=True)
+class Authorization:
+    """One EIP-7702 authorization tuple: authority (recovered from the
+    signature over keccak(0x05 || rlp([chain_id, address, nonce]))) asks
+    to set its code to the delegation designator 0xef0100 || address."""
+
+    chain_id: int
+    address: bytes  # 20-byte delegate (zero address clears the delegation)
+    nonce: int
+    y_parity: int
+    r: int
+    s: int
+
+    def fields(self) -> list:
+        return [
+            rlp.encode_uint(self.chain_id),
+            self.address,
+            rlp.encode_uint(self.nonce),
+            rlp.encode_uint(self.y_parity),
+            rlp.encode_uint(self.r),
+            rlp.encode_uint(self.s),
+        ]
+
+    @classmethod
+    def from_rlp_list(cls, items: list) -> "Authorization":
+        if not isinstance(items, list) or len(items) != 6:
+            raise rlp.DecodeError("authorization wants 6 fields")
+        address = bytes(items[1])
+        if len(address) != 20:
+            raise rlp.DecodeError("authorization address must be 20 bytes")
+        return cls(
+            chain_id=rlp.decode_uint(items[0]),
+            address=address,
+            nonce=rlp.decode_uint(items[2]),
+            y_parity=rlp.decode_uint(items[3]),
+            r=rlp.decode_uint(items[4]),
+            s=rlp.decode_uint(items[5]),
+        )
+
+
+@dataclass(frozen=True)
+class SetCodeTx:
+    """EIP-7702 typed tx 0x04 (Prague; beyond the reference's Shanghai
+    ceiling, src/types/transaction.zig stops at type 0x02): an EIP-1559
+    tx carrying a non-empty authorization list that installs delegation
+    designators on the signing authorities' accounts."""
+
+    chain_id_val: int
+    nonce: int
+    max_priority_fee_per_gas: int
+    max_fee_per_gas: int
+    gas_limit: int
+    to: Optional[bytes]  # MUST be a 20-byte address (no set-code creates)
+    value: int
+    data: bytes
+    access_list: Tuple[AccessListEntry, ...]
+    authorization_list: Tuple[Authorization, ...]
+    y_parity: int
+    r: int
+    s: int
+
+    tx_type: int = field(default=TX_TYPE_SET_CODE, init=False, repr=False)
+
+    def fields(self) -> list:
+        return [
+            rlp.encode_uint(self.chain_id_val),
+            rlp.encode_uint(self.nonce),
+            rlp.encode_uint(self.max_priority_fee_per_gas),
+            rlp.encode_uint(self.max_fee_per_gas),
+            rlp.encode_uint(self.gas_limit),
+            self.to if self.to is not None else b"",
+            rlp.encode_uint(self.value),
+            self.data,
+            _encode_access_list(self.access_list),
+            [a.fields() for a in self.authorization_list],
+            rlp.encode_uint(self.y_parity),
+            rlp.encode_uint(self.r),
+            rlp.encode_uint(self.s),
+        ]
+
+    def encode(self) -> bytes:
+        return bytes([TX_TYPE_SET_CODE]) + rlp.encode(self.fields())
+
+    def hash(self) -> bytes:
+        return keccak256(self.encode())
+
+    def chain_id(self) -> Optional[int]:
+        return self.chain_id_val
+
+    @classmethod
+    def from_rlp_list(cls, items: list) -> "SetCodeTx":
+        if len(items) != 13:
+            raise rlp.DecodeError(f"7702 tx wants 13 fields, got {len(items)}")
+        to = bytes(items[5])
+        if len(to) != 20:
+            raise rlp.DecodeError("set-code tx `to` must be a 20-byte address")
+        if not isinstance(items[9], list) or not items[9]:
+            raise rlp.DecodeError("set-code tx needs a non-empty auth list")
+        return cls(
+            chain_id_val=rlp.decode_uint(items[0]),
+            nonce=rlp.decode_uint(items[1]),
+            max_priority_fee_per_gas=rlp.decode_uint(items[2]),
+            max_fee_per_gas=rlp.decode_uint(items[3]),
+            gas_limit=rlp.decode_uint(items[4]),
+            to=to,
+            value=rlp.decode_uint(items[6]),
+            data=bytes(items[7]),
+            access_list=_decode_access_list(items[8]),
+            authorization_list=tuple(
+                Authorization.from_rlp_list(a) for a in items[9]
+            ),
+            y_parity=rlp.decode_uint(items[10]),
+            r=rlp.decode_uint(items[11]),
+            s=rlp.decode_uint(items[12]),
+        )
+
+
+Transaction = Union[LegacyTx, AccessListTx, FeeMarketTx, BlobTx, SetCodeTx]
 
 
 def decode_tx(data: bytes) -> Transaction:
@@ -336,6 +454,11 @@ def decode_tx(data: bytes) -> Transaction:
         if not isinstance(items, list):
             raise rlp.DecodeError("typed tx payload must be an RLP list")
         return BlobTx.from_rlp_list(items)
+    if first == TX_TYPE_SET_CODE:
+        items = rlp.decode(data[1:])
+        if not isinstance(items, list):
+            raise rlp.DecodeError("typed tx payload must be an RLP list")
+        return SetCodeTx.from_rlp_list(items)
     raise rlp.DecodeError(f"unsupported tx type 0x{first:02x}")
 
 
@@ -362,14 +485,14 @@ def encode_tx_for_block(tx: Transaction):
 def effective_gas_price(tx: Transaction, base_fee: int) -> int:
     """EIP-1559 effective price; legacy/2930 are flat gas_price
     (reference: src/blockchain/blockchain.zig:276-287)."""
-    if isinstance(tx, (FeeMarketTx, BlobTx)):
+    if isinstance(tx, (FeeMarketTx, BlobTx, SetCodeTx)):
         priority = min(tx.max_priority_fee_per_gas, tx.max_fee_per_gas - base_fee)
         return priority + base_fee
     return tx.gas_price
 
 
 def max_fee_per_gas(tx: Transaction) -> int:
-    if isinstance(tx, (FeeMarketTx, BlobTx)):
+    if isinstance(tx, (FeeMarketTx, BlobTx, SetCodeTx)):
         return tx.max_fee_per_gas
     return tx.gas_price
 
@@ -382,3 +505,7 @@ def access_list_of(tx: Transaction) -> Tuple[AccessListEntry, ...]:
     if isinstance(tx, LegacyTx):
         return ()
     return tx.access_list
+
+
+def authorization_list_of(tx: Transaction) -> Tuple["Authorization", ...]:
+    return tx.authorization_list if isinstance(tx, SetCodeTx) else ()

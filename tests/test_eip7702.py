@@ -1,0 +1,406 @@
+"""EIP-7702 set-code transactions (Prague), differential across the python
+and native EVM backends.
+
+The reference client stops at Shanghai (EVMC_SHANGHAI pinned with a TODO,
+reference: src/blockchain/vm.zig:472) — type-4 txs have no reference
+analog; semantics are pinned against EIP-7702's own rules: authorization
+processing (designator install/clear, nonce discipline, per-tuple skip),
+delegated execution in the authority's context, EXTCODE* marker
+visibility, the amended EIP-3607 sender rule, and gas/refund accounting.
+"""
+
+from dataclasses import replace as drep
+
+import pytest
+
+from phant_tpu import rlp
+from phant_tpu.crypto import secp256k1 as secp
+from phant_tpu.crypto.keccak import keccak256
+from phant_tpu.evm import gas as G
+from phant_tpu.mpt.mpt import EMPTY_TRIE_ROOT, ordered_trie_root
+from phant_tpu.signer.signer import (
+    TxSigner,
+    address_from_pubkey,
+    recover_authority,
+    sign_authorization,
+)
+from phant_tpu.state.statedb import StateDB
+from phant_tpu.types.account import Account
+from phant_tpu.types.block import Block, BlockHeader
+from phant_tpu.types.receipt import logs_bloom
+from phant_tpu.types.transaction import (
+    Authorization,
+    SetCodeTx,
+    decode_tx,
+)
+
+CHAIN_ID = 1
+SENDER_KEY = 0xAAA1
+AUTH_KEY = 0xBBB2
+SENDER = address_from_pubkey(secp.pubkey_of(SENDER_KEY))
+AUTHORITY = address_from_pubkey(secp.pubkey_of(AUTH_KEY))
+DELEGATE = b"\xde" * 20
+
+# delegate runtime: SSTORE(0, CALLVALUE + 7); STOP — writes into whatever
+# account's storage context it executes in
+DELEGATE_CODE = bytes.fromhex("6007340160005500")
+
+
+def _set_code_tx(auths, to=None, nonce=0, data=b"", value=0, gas=400_000):
+    return SetCodeTx(
+        chain_id_val=CHAIN_ID,
+        nonce=nonce,
+        max_priority_fee_per_gas=1,
+        max_fee_per_gas=10**10,
+        gas_limit=gas,
+        to=to if to is not None else AUTHORITY,
+        value=value,
+        data=data,
+        access_list=(),
+        authorization_list=tuple(auths),
+        y_parity=0,
+        r=0,
+        s=0,
+    )
+
+
+def _genesis(extra_accounts=None):
+    accounts = {
+        SENDER: Account(balance=10**24),
+        DELEGATE: Account(code=DELEGATE_CODE),
+    }
+    accounts.update(extra_accounts or {})
+    header = BlockHeader(
+        block_number=0, gas_limit=30_000_000, gas_used=0,
+        timestamp=1_800_000_000, base_fee_per_gas=10**9,
+        withdrawals_root=EMPTY_TRIE_ROOT, blob_gas_used=0, excess_blob_gas=0,
+    )
+    return accounts, header
+
+
+def _block_with(txs, genesis, chain):
+    from phant_tpu.blockchain.chain import calculate_base_fee
+
+    base_fee = calculate_base_fee(
+        genesis.gas_limit, genesis.gas_used, genesis.base_fee_per_gas
+    )
+    draft = BlockHeader(
+        parent_hash=genesis.hash(), block_number=1,
+        gas_limit=30_000_000, gas_used=0, timestamp=genesis.timestamp + 12,
+        base_fee_per_gas=base_fee,
+        transactions_root=ordered_trie_root(
+            [t.encode() if not hasattr(t, "v") else rlp.encode(t.fields()) for t in txs]
+        ),
+        receipts_root=EMPTY_TRIE_ROOT, withdrawals_root=EMPTY_TRIE_ROOT,
+        logs_bloom=logs_bloom([]),
+        blob_gas_used=0, excess_blob_gas=0,
+        parent_beacon_block_root=b"\x5b" * 32,
+    )
+    result = chain.apply_body(
+        Block(header=draft, transactions=tuple(txs), withdrawals=())
+    )
+    header = drep(
+        draft,
+        gas_used=result.gas_used,
+        receipts_root=ordered_trie_root([r.encode() for r in result.receipts]),
+        logs_bloom=result.logs_bloom,
+    )
+    return Block(header=header, transactions=tuple(txs), withdrawals=()), result
+
+
+def _run_block(txs, extra_accounts=None):
+    from phant_tpu.blockchain.chain import Blockchain
+    from phant_tpu.blockchain.fork import CancunFork
+
+    accounts, genesis = _genesis(extra_accounts)
+    build_state = StateDB({a: acct.copy() for a, acct in accounts.items()})
+    build_chain = Blockchain(
+        CHAIN_ID, build_state, genesis,
+        fork=CancunFork(build_state), verify_state_root=False,
+    )
+    block, _ = _block_with(txs, genesis, build_chain)
+
+    state = StateDB({a: acct.copy() for a, acct in accounts.items()})
+    chain = Blockchain(
+        CHAIN_ID, state, genesis,
+        fork=CancunFork(state), verify_state_root=False,
+    )
+    chain.run_block(block)
+    return state, block
+
+
+# ---------------------------------------------------------------------------
+# codec + signatures
+# ---------------------------------------------------------------------------
+
+
+def test_codec_roundtrip_and_hash():
+    signer = TxSigner(CHAIN_ID)
+    auth = sign_authorization(CHAIN_ID, DELEGATE, 0, AUTH_KEY)
+    tx = signer.sign(_set_code_tx([auth]), SENDER_KEY)
+    blob = tx.encode()
+    assert blob[0] == 0x04
+    back = decode_tx(blob)
+    assert back == tx
+    assert back.hash() == keccak256(blob)
+    # sender recovers through the generic signer path
+    assert signer.get_sender(tx) == SENDER
+
+
+def test_decode_rejects_malformed():
+    signer = TxSigner(CHAIN_ID)
+    auth = sign_authorization(CHAIN_ID, DELEGATE, 0, AUTH_KEY)
+    tx = signer.sign(_set_code_tx([auth]), SENDER_KEY)
+    # empty authorization list
+    no_auth = rlp.decode(tx.encode()[1:])
+    no_auth[9] = []
+    with pytest.raises(rlp.DecodeError):
+        decode_tx(b"\x04" + rlp.encode(no_auth))
+    # truncated `to`
+    bad_to = rlp.decode(tx.encode()[1:])
+    bad_to[5] = b"\x01\x02"
+    with pytest.raises(rlp.DecodeError):
+        decode_tx(b"\x04" + rlp.encode(bad_to))
+    with pytest.raises(rlp.DecodeError):
+        decode_tx(b"\x04\xde\xad")
+
+
+def test_authority_recovery():
+    auth = sign_authorization(CHAIN_ID, DELEGATE, 5, AUTH_KEY)
+    assert recover_authority(auth) == AUTHORITY
+    # a corrupted signature recovers a different (or no) authority
+    bad = Authorization(
+        chain_id=auth.chain_id, address=auth.address, nonce=auth.nonce,
+        y_parity=auth.y_parity, r=auth.r ^ 1, s=auth.s,
+    )
+    assert recover_authority(bad) != AUTHORITY
+    # high-s is malleable and refused outright
+    high_s = Authorization(
+        chain_id=auth.chain_id, address=auth.address, nonce=auth.nonce,
+        y_parity=auth.y_parity, r=auth.r, s=secp.N - 1,
+    )
+    assert recover_authority(high_s) is None
+
+
+# ---------------------------------------------------------------------------
+# end-to-end delegated execution
+# ---------------------------------------------------------------------------
+
+
+def test_delegated_execution_in_authority_context(evm_backend):
+    """The type-4 tx installs 0xef0100‖delegate on the authority, then the
+    same tx's call to the authority runs the delegate's code in the
+    AUTHORITY's storage context."""
+    signer = TxSigner(CHAIN_ID)
+    auth = sign_authorization(CHAIN_ID, DELEGATE, 0, AUTH_KEY)
+    tx = signer.sign(_set_code_tx([auth], to=AUTHORITY, value=3), SENDER_KEY)
+    state, block = _run_block([tx])
+
+    # delegation designator installed + authority nonce bumped
+    assert state.get_code(AUTHORITY) == G.DELEGATION_PREFIX + DELEGATE
+    assert state.get_nonce(AUTHORITY) == 1
+    # delegate code ran with the authority's storage: slot0 = value + 7
+    assert state.get_storage(AUTHORITY, 0) == 3 + 7
+    assert state.get_storage(DELEGATE, 0) == 0
+    # the receipt consumed at least intrinsic + PER_EMPTY_ACCOUNT_COST
+    assert block.header.gas_used >= 21_000 + G.PER_EMPTY_ACCOUNT_COST
+
+
+def test_clear_delegation_with_zero_address(evm_backend):
+    signer = TxSigner(CHAIN_ID)
+    pre = {
+        AUTHORITY: Account(
+            balance=10**18, nonce=0, code=G.DELEGATION_PREFIX + DELEGATE
+        )
+    }
+    auth = sign_authorization(CHAIN_ID, b"\x00" * 20, 0, AUTH_KEY)
+    tx = signer.sign(_set_code_tx([auth], to=SENDER), SENDER_KEY)
+    state, _ = _run_block([tx], extra_accounts=pre)
+    assert state.get_code(AUTHORITY) == b""
+    assert state.get_nonce(AUTHORITY) == 1
+
+
+def test_tuple_skips_never_invalidate_tx(evm_backend):
+    """Bad tuples (wrong chain, wrong nonce, contract-coded authority) are
+    skipped; good tuples in the same list still apply."""
+    signer = TxSigner(CHAIN_ID)
+    contract_key = 0xCCC3
+    contract_authority = address_from_pubkey(secp.pubkey_of(contract_key))
+    pre = {contract_authority: Account(code=b"\x60\x00")}  # a real contract
+    auths = [
+        sign_authorization(7, DELEGATE, 0, AUTH_KEY),         # wrong chain
+        sign_authorization(CHAIN_ID, DELEGATE, 9, AUTH_KEY),  # wrong nonce
+        sign_authorization(CHAIN_ID, DELEGATE, 0, contract_key),  # has code
+        sign_authorization(CHAIN_ID, DELEGATE, 0, AUTH_KEY),  # good
+    ]
+    tx = signer.sign(_set_code_tx(auths, to=SENDER), SENDER_KEY)
+    state, _ = _run_block([tx], extra_accounts=pre)
+    assert state.get_code(AUTHORITY) == G.DELEGATION_PREFIX + DELEGATE
+    assert state.get_code(contract_authority) == b"\x60\x00"
+    assert state.get_nonce(contract_authority) == 0
+
+
+def test_delegated_sender_allowed_by_amended_3607(evm_backend):
+    """An EOA carrying a delegation designator may originate transactions
+    (EIP-3607 as amended by EIP-7702) — here the delegated AUTHORITY sends
+    a plain value transfer."""
+    from phant_tpu.types.transaction import FeeMarketTx
+
+    signer = TxSigner(CHAIN_ID)
+    pre = {
+        AUTHORITY: Account(
+            balance=10**20, nonce=4, code=G.DELEGATION_PREFIX + DELEGATE
+        )
+    }
+    send = signer.sign(
+        FeeMarketTx(
+            chain_id_val=CHAIN_ID, nonce=4, max_priority_fee_per_gas=1,
+            max_fee_per_gas=10**10, gas_limit=100_000, to=SENDER, value=123,
+            data=b"", access_list=(), y_parity=0, r=0, s=0,
+        ),
+        AUTH_KEY,
+    )
+    state, _ = _run_block([send], extra_accounts=pre)
+    assert state.get_nonce(AUTHORITY) == 5
+
+
+def test_extcode_views_see_marker(evm_backend):
+    """EXTCODESIZE/EXTCODECOPY/EXTCODEHASH on a delegated account operate
+    on the 2-byte 0xef01 marker, not the designator or delegate code."""
+    signer = TxSigner(CHAIN_ID)
+    prober = b"\xab" * 20
+    # EXTCODESIZE(authority)->slot0; EXTCODEHASH(authority)->slot1;
+    # EXTCODECOPY(authority, 0, 0, 2); MLOAD(0)->slot2
+    probe_code = (
+        bytes.fromhex("73") + AUTHORITY + bytes.fromhex("3b600055")
+        + bytes.fromhex("73") + AUTHORITY + bytes.fromhex("3f600155")
+        + bytes.fromhex("60026000600073") + AUTHORITY + bytes.fromhex("3c")
+        + bytes.fromhex("600051600255")
+        + bytes.fromhex("00")
+    )
+    pre = {
+        prober: Account(code=probe_code),
+        AUTHORITY: Account(code=G.DELEGATION_PREFIX + DELEGATE, nonce=1),
+    }
+    auth = sign_authorization(CHAIN_ID, DELEGATE, 0, 0xF00D)  # unrelated
+    tx = signer.sign(_set_code_tx([auth], to=prober), SENDER_KEY)
+    state, _ = _run_block([tx], extra_accounts=pre)
+    assert state.get_storage(prober, 0) == 2
+    assert state.get_storage(prober, 1) == int.from_bytes(
+        keccak256(b"\xef\x01"), "big"
+    )
+    assert state.get_storage(prober, 2) == int.from_bytes(
+        b"\xef\x01" + b"\x00" * 30, "big"
+    )
+
+
+def test_existing_authority_earns_refund(evm_backend):
+    """An authority that already exists in the trie refunds
+    PER_EMPTY_ACCOUNT_COST - PER_AUTH_BASE_COST (subject to the EIP-3529
+    gas_used/5 cap) relative to a fresh authority."""
+    signer = TxSigner(CHAIN_ID)
+    fresh_key = 0xFEED
+    pre = {AUTHORITY: Account(balance=10**18, nonce=0)}
+
+    # enough calldata that the EIP-3529 gas_used/5 cap does not clip the
+    # 12500 refund (21000 + 25000 + 64*16*... -> cap comfortably > 12500)
+    payload = b"\xff" * 3000
+    auth_existing = sign_authorization(CHAIN_ID, DELEGATE, 0, AUTH_KEY)
+    tx1 = signer.sign(
+        _set_code_tx([auth_existing], to=SENDER, data=payload), SENDER_KEY
+    )
+    state1, block1 = _run_block([tx1], extra_accounts=pre)
+
+    auth_fresh = sign_authorization(CHAIN_ID, DELEGATE, 0, fresh_key)
+    tx2 = signer.sign(
+        _set_code_tx([auth_fresh], to=SENDER, data=payload), SENDER_KEY
+    )
+    state2, block2 = _run_block([tx2], extra_accounts=pre)
+
+    assert block2.header.gas_used - block1.header.gas_used == (
+        G.PER_EMPTY_ACCOUNT_COST - G.PER_AUTH_BASE_COST
+    )
+
+
+def test_set_code_tx_rejected_before_prague():
+    """Without Prague active (no blob fields, no config), a type-4 tx is an
+    invalid-block condition, mirroring the blob-tx gating."""
+    from phant_tpu.blockchain.chain import Blockchain, BlockError
+
+    signer = TxSigner(CHAIN_ID)
+    auth = sign_authorization(CHAIN_ID, DELEGATE, 0, AUTH_KEY)
+    tx = signer.sign(_set_code_tx([auth]), SENDER_KEY)
+    accounts, genesis = _genesis()
+    genesis = drep(genesis, blob_gas_used=None, excess_blob_gas=None)
+    state = StateDB({a: acct.copy() for a, acct in accounts.items()})
+    chain = Blockchain(CHAIN_ID, state, genesis, verify_state_root=False)
+    header = BlockHeader(
+        parent_hash=genesis.hash(), block_number=1,
+        gas_limit=30_000_000, gas_used=21_000,
+        timestamp=genesis.timestamp + 12,
+        base_fee_per_gas=genesis.base_fee_per_gas,
+        transactions_root=ordered_trie_root([tx.encode()]),
+        receipts_root=EMPTY_TRIE_ROOT, withdrawals_root=EMPTY_TRIE_ROOT,
+        logs_bloom=logs_bloom([]),
+    )
+    with pytest.raises(BlockError):
+        chain.run_block(
+            Block(header=header, transactions=(tx,), withdrawals=())
+        )
+
+
+def test_delegation_chain_does_not_recurse(evm_backend):
+    """A designator pointing at another delegated account executes the raw
+    designator bytes (halting on 0xEF) instead of following the chain."""
+    signer = TxSigner(CHAIN_ID)
+    middle = b"\xa1" * 20
+    pre = {
+        AUTHORITY: Account(code=G.DELEGATION_PREFIX + middle, nonce=1),
+        middle: Account(code=G.DELEGATION_PREFIX + DELEGATE, nonce=1),
+    }
+    auth = sign_authorization(CHAIN_ID, DELEGATE, 0, 0xF00D)  # unrelated
+    tx = signer.sign(
+        _set_code_tx([auth], to=AUTHORITY, value=1, gas=400_000), SENDER_KEY
+    )
+    state, _ = _run_block([tx], extra_accounts=pre)
+    # neither storage context was written: the chained designator halted
+    assert state.get_storage(AUTHORITY, 0) == 0
+    assert state.get_storage(middle, 0) == 0
+    assert state.get_storage(DELEGATE, 0) == 0
+
+
+def test_nested_call_to_delegated_gas_identical_across_backends():
+    """A contract CALLing a delegated account exercises the caller-side
+    EIP-7702 access charge (the host delegate_access_cost callback on the
+    native core, the inline helper on the python one) — both backends
+    must burn EXACTLY the same gas."""
+    from phant_tpu.backend import set_evm_backend
+    from phant_tpu.evm.native_vm import native_available
+
+    if not native_available():
+        pytest.skip("native toolchain unavailable")
+
+    signer = TxSigner(CHAIN_ID)
+    caller = b"\xca" * 20
+    # CALL(gas=100000, AUTHORITY, value=0, in 0/0, out 0/0); pop; STOP
+    caller_code = (
+        bytes.fromhex("6000600060006000600073") + AUTHORITY
+        + bytes.fromhex("620186a0f1" + "50" + "00")
+    )
+    pre = {
+        caller: Account(code=caller_code),
+        AUTHORITY: Account(code=G.DELEGATION_PREFIX + DELEGATE, nonce=1),
+    }
+    auth = sign_authorization(CHAIN_ID, DELEGATE, 0, 0xF00D)  # unrelated
+    used = {}
+    for be in ("python", "native"):
+        set_evm_backend(be)
+        try:
+            tx = signer.sign(_set_code_tx([auth], to=caller), SENDER_KEY)
+            state, block = _run_block([tx], extra_accounts=pre)
+            used[be] = block.header.gas_used
+            # the delegate ran in the AUTHORITY's storage context
+            assert state.get_storage(AUTHORITY, 0) == 7
+        finally:
+            set_evm_backend("python")
+    assert used["python"] == used["native"], used
