@@ -261,14 +261,17 @@ class Blockchain:
         return header.excess_blob_gas is not None
 
     def prague_active(self, header: BlockHeader) -> bool:
-        """Prague dispatch (EIP-7702 set-code txs, EIP-7691 blob schedule,
-        EIP-2935 ring). Config-less chains (fixtures/synthetic) activate
-        Prague together with Cancun's self-describing blob fields so the
-        differential suites can exercise type-4 txs without a chainspec."""
+        """Prague dispatch (EIP-7702 set-code txs, EIP-7623 calldata
+        floor, EIP-7691 blob schedule, EIP-2935 ring). Config-less chains
+        (fixtures/synthetic) follow the fork instance they were built
+        with — the same rule blob_schedule uses, so a CancunFork chain
+        can never half-activate Prague."""
+        from phant_tpu.blockchain.fork import PragueFork
+
         if self.config is not None:
             name = self.config.fork_at(header.block_number, header.timestamp)
             return name in ("prague", "osaka")
-        return header.excess_blob_gas is not None
+        return isinstance(self.fork, PragueFork)
 
     def blob_schedule(self, header: BlockHeader) -> tuple:
         """(max_blob_gas, target_blob_gas, fee_update_fraction) for this
@@ -493,15 +496,22 @@ class Blockchain:
         )
         if intrinsic > tx.gas_limit:
             raise BlockError("intrinsic gas exceeds limit")
+        if self.prague_active(header) and G.calldata_floor_gas(tx.data) > tx.gas_limit:
+            raise BlockError("gas limit below EIP-7623 calldata floor")
 
         sender_acct = self.state.get_account(sender)
         nonce = sender_acct.nonce if sender_acct else 0
         if nonce != tx.nonce:
             raise BlockError(f"nonce mismatch: tx {tx.nonce}, account {nonce}")
         if sender_acct is not None and sender_acct.code:
-            # EIP-3607, as amended by EIP-7702: an EOA carrying a delegation
-            # designator may still originate transactions
-            if not G.is_delegation_designator(sender_acct.code):
+            # EIP-3607, as amended by EIP-7702 — but the designator
+            # exemption exists only once Prague is live; pre-Prague every
+            # code-bearing sender is rejected (consensus: other clients
+            # reject such blocks too)
+            if not (
+                self.prague_active(header)
+                and G.is_delegation_designator(sender_acct.code)
+            ):
                 raise BlockError("sender is not EOA (EIP-3607)")
         max_cost = tx.gas_limit * max_fee_per_gas(tx) + tx.value + blob_fee
         balance = sender_acct.balance if sender_acct else 0
@@ -672,6 +682,11 @@ class Blockchain:
         counter = (state.refund if result.success else 0) + auth_refund
         refund = min(counter, gas_used // G.REFUND_QUOTIENT)
         gas_used -= refund
+        if revision >= REVISION_PRAGUE:
+            # EIP-7623: calldata-heavy txs pay at least the floor price
+            # (applied after refunds; check_transaction already rejected
+            # gas limits below the floor)
+            gas_used = max(gas_used, G.calldata_floor_gas(tx.data))
         state.add_balance(sender, (tx.gas_limit - gas_used) * gas_price)
 
         # coinbase priority fee (reference: blockchain.zig:325-331)

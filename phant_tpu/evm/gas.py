@@ -162,6 +162,29 @@ PER_AUTH_BASE_COST = 12_500  # floor cost per authorization tuple
 PER_EMPTY_ACCOUNT_COST = 25_000  # charged up front per tuple (intrinsic)
 DELEGATION_PREFIX = b"\xef\x01\x00"  # designator: 0xef0100 || address
 DELEGATION_MARKER = b"\xef\x01"  # what EXTCODE* see on a delegated account
+# keccak256(DELEGATION_MARKER), precomputed: EXTCODEHASH of any delegated
+# account (a constant; recomputing it per opcode would be waste)
+DELEGATION_MARKER_HASH = bytes.fromhex(
+    "eadcdba66a79ab5dce91622d1d75c8cff5cff0b96944c3bf1072cd08ce018329"
+)
+
+
+# --- Prague EIP-7623 calldata floor pricing ---
+STANDARD_TOKEN_COST = 4
+TOTAL_COST_FLOOR_PER_TOKEN = 10
+
+
+def calldata_tokens(data: bytes) -> int:
+    """EIP-7623 token count: 1 per zero byte, 4 per nonzero byte (so the
+    pre-7623 calldata charge is exactly STANDARD_TOKEN_COST per token)."""
+    zeros = data.count(0)  # C-speed; this runs per tx in the block loop
+    return zeros + 4 * (len(data) - zeros)
+
+
+def calldata_floor_gas(data: bytes) -> int:
+    """The EIP-7623 minimum a transaction must pay: 21000 + 10/token.
+    Applied as max(execution gas used, floor) after refunds, Prague on."""
+    return TX_BASE_COST + TOTAL_COST_FLOOR_PER_TOKEN * calldata_tokens(data)
 
 
 def is_delegation_designator(code: bytes) -> bool:

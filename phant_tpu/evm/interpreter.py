@@ -642,6 +642,17 @@ def _visible_code(evm, addr: bytes) -> bytes:
     return code
 
 
+def visible_code_hash(evm, addr: bytes):
+    """EXTCODEHASH semantics shared by both backends: None for an empty
+    account (the opcode pushes 0), the precomputed marker hash for a
+    delegated account, the stored code hash otherwise."""
+    if evm.state.is_empty(addr):
+        return None
+    if _visible_code(evm, addr) == G.DELEGATION_MARKER:
+        return G.DELEGATION_MARKER_HASH
+    return evm.state.get_account(addr).code_hash()
+
+
 def delegation_access_cost(evm, code_addr: bytes) -> int:
     """EIP-7702 surcharge for calling through a delegated account: warms
     the delegate and returns its warm/cold access cost (0 when the target
@@ -697,15 +708,8 @@ def _extcodehash(evm, frame):
     addr = _int_to_addr(frame.pop())
     warm = evm.state.access_address(addr)
     frame.use_gas(G.WARM_ACCOUNT_ACCESS if warm else G.COLD_ACCOUNT_ACCESS)
-    if evm.state.is_empty(addr):
-        frame.push(0)
-    else:
-        code = _visible_code(evm, addr)
-        acct = evm.state.get_account(addr)
-        if code == G.DELEGATION_MARKER:  # delegated: hash of the marker
-            frame.push(int.from_bytes(keccak256(code), "big"))
-        else:
-            frame.push(int.from_bytes(acct.code_hash(), "big"))
+    h = visible_code_hash(evm, addr)
+    frame.push(0 if h is None else int.from_bytes(h, "big"))
 
 
 # ---- 0x40s: block ----

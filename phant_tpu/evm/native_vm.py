@@ -18,6 +18,7 @@ import ctypes as ct
 from typing import Optional
 
 from phant_tpu.evm import gas as G
+from phant_tpu.evm.interpreter import _visible_code, delegation_access_cost
 from phant_tpu.evm.message import ExecResult, Message
 from phant_tpu.types.receipt import Log
 
@@ -278,22 +279,15 @@ class NativeSession:
         _write32(out, self.state.get_balance(_bytes20(addr)))
 
     def _cb_get_code_size(self, _ctx, addr) -> int:
-        from phant_tpu.evm.interpreter import _visible_code
-
         return len(_visible_code(self.evm, _bytes20(addr)))
 
     def _cb_copy_code(self, _ctx, addr, offset, out, size) -> None:
-        from phant_tpu.evm.interpreter import _visible_code
-
         code = _visible_code(self.evm, _bytes20(addr))
         chunk = code[offset : offset + size]
         if chunk:
             ct.memmove(out, chunk, len(chunk))
 
     def _cb_get_code_hash(self, _ctx, addr, out) -> None:
-        from phant_tpu.crypto.keccak import keccak256
-        from phant_tpu.evm.interpreter import _visible_code
-
         address = _bytes20(addr)
         acct = self.state.get_account(address)
         if acct is None:
@@ -301,13 +295,11 @@ class NativeSession:
             return
         code = _visible_code(self.evm, address)
         if code == G.DELEGATION_MARKER:  # delegated: hash of the marker
-            ct.memmove(out, keccak256(code), 32)
+            ct.memmove(out, G.DELEGATION_MARKER_HASH, 32)
         else:
             ct.memmove(out, acct.code_hash(), 32)
 
     def _cb_delegate_access_cost(self, _ctx, addr) -> int:
-        from phant_tpu.evm.interpreter import delegation_access_cost
-
         return delegation_access_cost(self.evm, _bytes20(addr))
 
     def _cb_is_empty(self, _ctx, addr) -> int:

@@ -110,20 +110,20 @@ def _block_with(txs, genesis, chain):
 
 def _run_block(txs, extra_accounts=None):
     from phant_tpu.blockchain.chain import Blockchain
-    from phant_tpu.blockchain.fork import CancunFork
+    from phant_tpu.blockchain.fork import PragueFork
 
     accounts, genesis = _genesis(extra_accounts)
     build_state = StateDB({a: acct.copy() for a, acct in accounts.items()})
     build_chain = Blockchain(
         CHAIN_ID, build_state, genesis,
-        fork=CancunFork(build_state), verify_state_root=False,
+        fork=PragueFork(build_state), verify_state_root=False,
     )
     block, _ = _block_with(txs, genesis, build_chain)
 
     state = StateDB({a: acct.copy() for a, acct in accounts.items()})
     chain = Blockchain(
         CHAIN_ID, state, genesis,
-        fork=CancunFork(state), verify_state_root=False,
+        fork=PragueFork(state), verify_state_root=False,
     )
     chain.run_block(block)
     return state, block
@@ -302,18 +302,23 @@ def test_existing_authority_earns_refund(evm_backend):
     fresh_key = 0xFEED
     pre = {AUTHORITY: Account(balance=10**18, nonce=0)}
 
-    # enough calldata that the EIP-3529 gas_used/5 cap does not clip the
-    # 12500 refund (21000 + 25000 + 64*16*... -> cap comfortably > 12500)
-    payload = b"\xff" * 3000
+    # burn enough EXECUTION gas that (a) the EIP-3529 gas_used/5 cap does
+    # not clip the 12500 refund and (b) the EIP-7623 calldata floor stays
+    # below the metered gas (calldata alone cannot do both: its floor
+    # grows 2.5x faster than its 16/byte charge). KECCAK over 80000 bytes
+    # of fresh memory is ~35k gas of pure compute.
+    burner = b"\xbb" * 20
+    burner_code = bytes.fromhex("620138806000205000")
+    pre[burner] = Account(code=burner_code)
     auth_existing = sign_authorization(CHAIN_ID, DELEGATE, 0, AUTH_KEY)
     tx1 = signer.sign(
-        _set_code_tx([auth_existing], to=SENDER, data=payload), SENDER_KEY
+        _set_code_tx([auth_existing], to=burner), SENDER_KEY
     )
     state1, block1 = _run_block([tx1], extra_accounts=pre)
 
     auth_fresh = sign_authorization(CHAIN_ID, DELEGATE, 0, fresh_key)
     tx2 = signer.sign(
-        _set_code_tx([auth_fresh], to=SENDER, data=payload), SENDER_KEY
+        _set_code_tx([auth_fresh], to=burner), SENDER_KEY
     )
     state2, block2 = _run_block([tx2], extra_accounts=pre)
 
@@ -404,3 +409,107 @@ def test_nested_call_to_delegated_gas_identical_across_backends():
         finally:
             set_evm_backend("python")
     assert used["python"] == used["native"], used
+
+
+# ---------------------------------------------------------------------------
+# EIP-7623 calldata floor pricing (Prague)
+# ---------------------------------------------------------------------------
+
+
+def test_calldata_floor_binds_for_data_heavy_tx(evm_backend):
+    """A calldata-heavy tx with trivial execution pays the EIP-7623 floor
+    (21000 + 10/token), not the cheaper 4/16-per-byte metered cost."""
+    from phant_tpu.types.transaction import FeeMarketTx
+
+    signer = TxSigner(CHAIN_ID)
+    data = b"\x00" * 1000 + b"\xff" * 1000
+    tx = signer.sign(
+        FeeMarketTx(
+            chain_id_val=CHAIN_ID, nonce=0, max_priority_fee_per_gas=1,
+            max_fee_per_gas=10**10, gas_limit=200_000, to=DELEGATE,
+            value=0, data=data, access_list=(), y_parity=0, r=0, s=0,
+        ),
+        SENDER_KEY,
+    )
+    state, block = _run_block([tx])
+    floor = G.calldata_floor_gas(data)
+    assert floor == 21_000 + 10 * (1000 + 4 * 1000)
+    # metered: 21000 + 4*1000 + 16*1000 + a little execution < floor
+    assert block.header.gas_used == floor
+
+
+def test_calldata_floor_does_not_bind_compute_heavy_tx(evm_backend):
+    """Execution above the floor is charged normally — the floor is a
+    minimum, not a surcharge."""
+    from phant_tpu.types.transaction import FeeMarketTx
+
+    burner = b"\xbc" * 20
+    burner_code = bytes.fromhex("620138806000205000")  # ~35k gas keccak
+    signer = TxSigner(CHAIN_ID)
+    tx = signer.sign(
+        FeeMarketTx(
+            chain_id_val=CHAIN_ID, nonce=0, max_priority_fee_per_gas=1,
+            max_fee_per_gas=10**10, gas_limit=200_000, to=burner,
+            value=0, data=b"\x01", access_list=(), y_parity=0, r=0, s=0,
+        ),
+        SENDER_KEY,
+    )
+    state, block = _run_block(
+        [tx], extra_accounts={burner: Account(code=burner_code)}
+    )
+    assert block.header.gas_used > G.calldata_floor_gas(b"\x01")
+    assert block.header.gas_used > 50_000  # the burner actually ran
+
+
+def test_gas_limit_below_floor_is_invalid():
+    """Prague txs must budget at least the calldata floor."""
+    from phant_tpu.blockchain.chain import BlockError
+    from phant_tpu.types.transaction import FeeMarketTx
+
+    signer = TxSigner(CHAIN_ID)
+    data = b"\xff" * 2000  # floor = 21000 + 80000
+    tx = signer.sign(
+        FeeMarketTx(
+            chain_id_val=CHAIN_ID, nonce=0, max_priority_fee_per_gas=1,
+            max_fee_per_gas=10**10, gas_limit=60_000, to=DELEGATE,
+            value=0, data=data, access_list=(), y_parity=0, r=0, s=0,
+        ),
+        SENDER_KEY,
+    )
+    with pytest.raises(Exception) as exc_info:
+        _run_block([tx])
+    assert "floor" in str(exc_info.value) or "gas" in str(exc_info.value)
+
+
+def test_delegated_sender_rejected_pre_prague():
+    """Pre-Prague, EIP-3607 has no designator exemption: a code-bearing
+    sender (even 23-byte 0xef0100-shaped) is rejected — matching what
+    every spec-compliant client does before the fork."""
+    from phant_tpu.blockchain.chain import Blockchain, BlockError
+    from phant_tpu.blockchain.fork import CancunFork
+    from phant_tpu.types.transaction import FeeMarketTx
+
+    signer = TxSigner(CHAIN_ID)
+    pre = {
+        AUTHORITY: Account(
+            balance=10**20, nonce=4, code=G.DELEGATION_PREFIX + DELEGATE
+        )
+    }
+    send = signer.sign(
+        FeeMarketTx(
+            chain_id_val=CHAIN_ID, nonce=4, max_priority_fee_per_gas=1,
+            max_fee_per_gas=10**10, gas_limit=100_000, to=SENDER, value=1,
+            data=b"", access_list=(), y_parity=0, r=0, s=0,
+        ),
+        AUTH_KEY,
+    )
+    accounts, genesis = _genesis(pre)
+    state = StateDB({a: acct.copy() for a, acct in accounts.items()})
+    chain = Blockchain(
+        CHAIN_ID, state, genesis,
+        fork=CancunFork(state), verify_state_root=False,
+    )
+    with pytest.raises(BlockError, match="EIP-3607"):
+        chain.check_transaction(
+            send, genesis, gas_available=30_000_000, sender=AUTHORITY
+        )
