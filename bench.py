@@ -548,10 +548,16 @@ def _tunnel_profile() -> dict:
         from phant_tpu.backend import device_link_profile
 
         up_bps, rtt = device_link_profile()
-        return {
+        out = {
             "tunnel_upload_mbps": round(up_bps / 1e6, 1),
             "tunnel_roundtrip_ms": round(rtt * 1e3, 1),
         }
+        if up_bps >= 50e9:
+            # the probe hit the sanity clamp: a loopback relay ACKs the
+            # upload at memory speed and streams to the chip behind the
+            # (measured) round trip, so RTT is the honest link cost here
+            out["tunnel_upload_note"] = "clamped: relay-buffered upload"
+        return out
     except Exception as e:
         return {"tunnel_probe_error": repr(e)[:120]}
 
@@ -598,26 +604,38 @@ def verify_cpu(witnesses, fast_keccak: bool = False) -> int:
     return ok
 
 
-def _run_engine(warm, span, hasher=None, backend=None, eng_batch=None):
+def _run_engine(warm, span, hasher=None, backend=None, eng_batch=None,
+                reps=None):
     """Warm on the prefix, then time the span (verdicts are host numpy —
-    the digest readbacks inside intern() make this sync-honest). Returns
-    (span_seconds, novel_hashed, stats, engine)."""
+    the digest readbacks inside intern() make this sync-honest). The
+    first-pass rate can only be measured once per engine (the span is
+    memoized afterwards), so the measurement repeats on FRESH engines and
+    keeps the best pass — single-shot timings on a shared box swing ±25%.
+    Returns (span_seconds, novel_hashed, stats, engine)."""
     from phant_tpu.backend import set_crypto_backend
     from phant_tpu.ops.witness_engine import WitnessEngine
 
     b = eng_batch or int(os.environ.get("PHANT_BENCH_ENGINE_BATCH", "64"))
+    if reps is None:
+        reps = int(os.environ.get("PHANT_BENCH_ENGINE_REPS", "3"))
     if backend:
         set_crypto_backend(backend)
     try:
-        eng = WitnessEngine(hasher=hasher)
-        for i in range(0, len(warm), b):
-            assert eng.verify_batch(warm[i : i + b]).all()
-        warm_hashed = eng.stats["hashed"]
-        t0 = time.perf_counter()
-        for i in range(0, len(span), b):
-            assert eng.verify_batch(span[i : i + b]).all()
-        dt = time.perf_counter() - t0
-        return dt, eng.stats["hashed"] - warm_hashed, dict(eng.stats), eng
+        best = float("inf")
+        for _ in range(max(reps, 1)):
+            eng = WitnessEngine(hasher=hasher)
+            for i in range(0, len(warm), b):
+                assert eng.verify_batch(warm[i : i + b]).all()
+            warm_hashed = eng.stats["hashed"]
+            t0 = time.perf_counter()
+            for i in range(0, len(span), b):
+                assert eng.verify_batch(span[i : i + b]).all()
+            dt = time.perf_counter() - t0
+            if dt < best:
+                best = dt
+                novel = eng.stats["hashed"] - warm_hashed
+                stats, engine = dict(eng.stats), eng
+        return best, novel, stats, engine
     finally:
         if backend:
             set_crypto_backend("cpu")
@@ -637,8 +655,10 @@ def sec_engine_cpu() -> dict:
     node_lists = [nodes for _root, nodes in span]
 
     verify_cpu(span[:4])  # warm the native lib
+    # best-of-3, matching the engine measurement (single passes on a
+    # shared box swing ±25%; the RATIO must not ride that noise)
     cpu_s = float("inf")
-    for _ in range(2):
+    for _ in range(3):
         t0 = time.perf_counter()
         ok_cpu = verify_cpu(span)
         cpu_s = min(cpu_s, time.perf_counter() - t0)
@@ -646,7 +666,7 @@ def sec_engine_cpu() -> dict:
     cpu_rate = n_blocks / cpu_s
     # transparency: the same full-recompute baseline with OUR SIMD keccak
     fastk_s = float("inf")
-    for _ in range(2):
+    for _ in range(3):
         t0 = time.perf_counter()
         assert verify_cpu(span, fast_keccak=True) == n_blocks
         fastk_s = min(fastk_s, time.perf_counter() - t0)
@@ -709,7 +729,8 @@ def sec_engine_device() -> dict:
     # transparency: the device FORCED on every novel batch
     try:
         efrc_s, _n, _s, _e2 = _run_engine(
-            warm, span, hasher=WitnessEngine._hash_batch_device, eng_batch=256
+            warm, span, hasher=WitnessEngine._hash_batch_device,
+            eng_batch=256, reps=1,  # transparency row only; minutes-slow
         )
         out["engine_tpu_forced_blocks_per_sec"] = round(n_blocks / efrc_s, 2)
         _bank({"engine_tpu_forced_blocks_per_sec": out["engine_tpu_forced_blocks_per_sec"]})

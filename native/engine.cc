@@ -43,6 +43,9 @@
 #include <chrono>
 #include <vector>
 
+extern "C" void phant_keccak256_ptrs_fast(const uint8_t* const*,
+                                          const uint32_t*, size_t, uint8_t*);
+
 namespace {
 
 constexpr int kChildSlots = 17;
@@ -270,6 +273,9 @@ struct Engine {
   // batch scratch (scan -> commit)
   std::vector<uint32_t> novel_dup;  // open table over this batch's novel set
   std::vector<const uint8_t*> ptr_scratch;  // blob-adapter node pointers
+  std::vector<const uint8_t*> novel_ptrs;  // commit_hash scratch
+  std::vector<uint32_t> novel_lens;
+  std::vector<uint8_t> digest_scratch;
 
   Engine() {
     seed = mix(reinterpret_cast<uint64_t>(this) ^ 0xa0761d6478bd642fULL,
@@ -499,6 +505,29 @@ int64_t phant_engine_commit_ptrs(void* h, const uint8_t* const* ptrs,
   for (uint64_t i = 0; i < n; ++i)
     if (rows[i] < -1) rows[i] = base_row + (-2 - rows[i]);
   return base_row;
+}
+
+// Commit with NATIVE hashing: digests of the novel nodes are computed
+// in-process through the fast keccak batch (no Python round trip). This
+// is the hot path when the routed backend is the host — the device route
+// still flows through phant_engine_commit_ptrs with caller digests.
+int64_t phant_engine_commit_hash_ptrs(void* h, const uint8_t* const* ptrs,
+                                      const uint32_t* lens, uint64_t n,
+                                      int64_t* rows,
+                                      const uint32_t* novel_idx,
+                                      uint64_t n_novel) {
+  Engine& E = *static_cast<Engine*>(h);
+  E.novel_ptrs.resize(n_novel);
+  E.novel_lens.resize(n_novel);
+  for (uint64_t k = 0; k < n_novel; ++k) {
+    E.novel_ptrs[k] = ptrs[novel_idx[k]];
+    E.novel_lens[k] = lens[novel_idx[k]];
+  }
+  E.digest_scratch.resize(32 * n_novel);
+  phant_keccak256_ptrs_fast(E.novel_ptrs.data(), E.novel_lens.data(),
+                            n_novel, E.digest_scratch.data());
+  return phant_engine_commit_ptrs(h, ptrs, lens, n, rows, novel_idx, n_novel,
+                                  E.digest_scratch.data());
 }
 
 // Contiguous-blob adapters (the ctypes/numpy interface): build the ptr

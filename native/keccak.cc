@@ -150,6 +150,42 @@ __attribute__((target("avx512f"))) void keccak_f1600_x8(__m512i a[25]) {
   }
 }
 
+// Transpose an 8x8 block of u64: in[m] = 8 consecutive words of lane m,
+// out[w] = word w across the 8 lanes. Three permute stages, 24 ops.
+__attribute__((target("avx512f"))) inline void transpose8x8(
+    const __m512i in[8], __m512i out[8]) {
+  const __m512i idxA = _mm512_setr_epi64(0, 1, 8, 9, 4, 5, 12, 13);
+  const __m512i idxB = _mm512_setr_epi64(2, 3, 10, 11, 6, 7, 14, 15);
+  const __m512i idxLo = _mm512_setr_epi64(0, 1, 2, 3, 8, 9, 10, 11);
+  const __m512i idxHi = _mm512_setr_epi64(4, 5, 6, 7, 12, 13, 14, 15);
+  // pairs: t0 = [r0w0,r1w0,r0w2,r1w2,r0w4,r1w4,r0w6,r1w6] etc.
+  __m512i t0 = _mm512_unpacklo_epi64(in[0], in[1]);
+  __m512i t1 = _mm512_unpackhi_epi64(in[0], in[1]);
+  __m512i t2 = _mm512_unpacklo_epi64(in[2], in[3]);
+  __m512i t3 = _mm512_unpackhi_epi64(in[2], in[3]);
+  __m512i t4 = _mm512_unpacklo_epi64(in[4], in[5]);
+  __m512i t5 = _mm512_unpackhi_epi64(in[4], in[5]);
+  __m512i t6 = _mm512_unpacklo_epi64(in[6], in[7]);
+  __m512i t7 = _mm512_unpackhi_epi64(in[6], in[7]);
+  // quads: qA0 = [r0w0,r1w0,r2w0,r3w0, r0w4,r1w4,r2w4,r3w4]
+  __m512i qA0 = _mm512_permutex2var_epi64(t0, idxA, t2);
+  __m512i qB0 = _mm512_permutex2var_epi64(t0, idxB, t2);
+  __m512i qA1 = _mm512_permutex2var_epi64(t4, idxA, t6);
+  __m512i qB1 = _mm512_permutex2var_epi64(t4, idxB, t6);
+  __m512i qA2 = _mm512_permutex2var_epi64(t1, idxA, t3);
+  __m512i qB2 = _mm512_permutex2var_epi64(t1, idxB, t3);
+  __m512i qA3 = _mm512_permutex2var_epi64(t5, idxA, t7);
+  __m512i qB3 = _mm512_permutex2var_epi64(t5, idxB, t7);
+  out[0] = _mm512_permutex2var_epi64(qA0, idxLo, qA1);
+  out[4] = _mm512_permutex2var_epi64(qA0, idxHi, qA1);
+  out[2] = _mm512_permutex2var_epi64(qB0, idxLo, qB1);
+  out[6] = _mm512_permutex2var_epi64(qB0, idxHi, qB1);
+  out[1] = _mm512_permutex2var_epi64(qA2, idxLo, qA3);
+  out[5] = _mm512_permutex2var_epi64(qA2, idxHi, qA3);
+  out[3] = _mm512_permutex2var_epi64(qB2, idxLo, qB3);
+  out[7] = _mm512_permutex2var_epi64(qB2, idxHi, qB3);
+}
+
 // Hash 8 messages; digests written to outs[m] as each lane retires.
 __attribute__((target("avx512f"))) void keccak256_x8(
     const uint8_t* const ptrs[8], const size_t lens[8], uint8_t* const outs[8]) {
@@ -161,28 +197,41 @@ __attribute__((target("avx512f"))) void keccak256_x8(
     nch[m] = lens[m] / kRate + 1;
     if (nch[m] > max_ch) max_ch = nch[m];
   }
-  alignas(64) uint64_t staging[17][8];
+  alignas(64) static const uint8_t kZeros[kRate] = {0};
+  alignas(64) uint8_t padbuf[8][kRate];
   alignas(64) uint64_t head[4][8];
   for (size_t c = 0; c < max_ch; ++c) {
-    std::memset(staging, 0, sizeof(staging));
+    // each lane's 136B rate block for this chunk: the message bytes for
+    // full blocks, a padded copy for the final block, zeros once retired
+    const uint8_t* blk[8];
     for (int m = 0; m < 8; ++m) {
-      if (c >= nch[m]) continue;  // retired lane: absorb zeros (state unused)
-      const uint8_t* src = ptrs[m] + c * kRate;
-      if (c + 1 < nch[m]) {  // full block
-        for (int w = 0; w < 17; ++w)
-          std::memcpy(&staging[w][m], src + 8 * w, 8);
+      if (c >= nch[m]) {  // retired lane: absorb zeros (state unused)
+        blk[m] = kZeros;
+      } else if (c + 1 < nch[m]) {  // full block: read in place
+        blk[m] = ptrs[m] + c * kRate;
       } else {  // final padded block
-        uint8_t block[kRate];
         const size_t rem = lens[m] - c * kRate;
-        std::memset(block, 0, sizeof(block));
-        if (rem) std::memcpy(block, src, rem);
-        block[rem] ^= 0x01;
-        block[kRate - 1] ^= 0x80;
-        for (int w = 0; w < 17; ++w) std::memcpy(&staging[w][m], block + 8 * w, 8);
+        std::memset(padbuf[m], 0, kRate);
+        if (rem) std::memcpy(padbuf[m], ptrs[m] + c * kRate, rem);
+        padbuf[m][rem] ^= 0x01;
+        padbuf[m][kRate - 1] ^= 0x80;
+        blk[m] = padbuf[m];
       }
     }
-    for (int w = 0; w < 17; ++w)
-      S[w] = _mm512_xor_si512(S[w], _mm512_load_si512(&staging[w][0]));
+    // words 0..15 via two 8x8 transposes straight from the block bytes
+    __m512i rows[8], lanes[8];
+    for (int half = 0; half < 2; ++half) {
+      for (int m = 0; m < 8; ++m)
+        rows[m] = _mm512_loadu_si512(blk[m] + 64 * half);
+      transpose8x8(rows, lanes);
+      for (int w = 0; w < 8; ++w) {
+        S[8 * half + w] = _mm512_xor_si512(S[8 * half + w], lanes[w]);
+      }
+    }
+    // straggler word 16 (bytes 128..135)
+    alignas(64) uint64_t w16[8];
+    for (int m = 0; m < 8; ++m) std::memcpy(&w16[m], blk[m] + 128, 8);
+    S[16] = _mm512_xor_si512(S[16], _mm512_load_si512(w16));
     keccak_f1600_x8(S);
     for (int m = 0; m < 8; ++m) {
       if (nch[m] != c + 1) continue;  // not this lane's final permute
@@ -220,13 +269,14 @@ void phant_keccak256_batch(const uint8_t* in, const uint64_t* offsets,
   }
 }
 
-// Batched, fast: 8-way AVX-512 multi-buffer when the CPU has it (runtime
-// dispatch; scalar otherwise/elsewhere). Bit-identical output, ~4-6x the
-// scalar batch on avx512 hosts. This is the framework's own hashing path
-// (witness-engine novel nodes, state-root plans, tx hashing).
-void phant_keccak256_batch_fast(const uint8_t* in, const uint64_t* offsets,
-                                const uint32_t* lens, size_t n,
-                                uint8_t* out) {
+// Batched, fast, scattered inputs (payload i at ptrs[i]): 8-way AVX-512
+// multi-buffer when the CPU has it (runtime dispatch; scalar otherwise/
+// elsewhere). Bit-identical output, ~4-6x the scalar batch on avx512
+// hosts. This is the framework's own hashing path (witness-engine novel
+// nodes, state-root plans, tx hashing).
+void phant_keccak256_ptrs_fast(const uint8_t* const* ptrs,
+                               const uint32_t* lens, size_t n,
+                               uint8_t* out) {
 #if defined(__x86_64__)
   if (have_avx512() && n >= 8) {
     // order by chunk count so grouped lanes retire together (stable:
@@ -251,25 +301,35 @@ void phant_keccak256_batch_fast(const uint8_t* in, const uint64_t* offsets,
     }
     size_t g = 0;
     for (; g + 8 <= n; g += 8) {
-      const uint8_t* ptrs[8];
+      const uint8_t* p8[8];
       size_t lens8[8];
       uint8_t* outs[8];
       for (int m = 0; m < 8; ++m) {
         const uint32_t i = order[g + m];
-        ptrs[m] = in + offsets[i];
+        p8[m] = ptrs[i];
         lens8[m] = lens[i];
         outs[m] = out + 32 * i;
       }
-      keccak256_x8(ptrs, lens8, outs);
+      keccak256_x8(p8, lens8, outs);
     }
     for (; g < n; ++g) {
       const uint32_t i = order[g];
-      keccak256_one(in + offsets[i], lens[i], out + 32 * i);
+      keccak256_one(ptrs[i], lens[i], out + 32 * i);
     }
     return;
   }
 #endif
-  phant_keccak256_batch(in, offsets, lens, n, out);
+  for (size_t i = 0; i < n; ++i) keccak256_one(ptrs[i], lens[i], out + 32 * i);
+}
+
+// Contiguous-blob adapter over the ptrs variant (the ctypes interface).
+void phant_keccak256_batch_fast(const uint8_t* in, const uint64_t* offsets,
+                                const uint32_t* lens, size_t n,
+                                uint8_t* out) {
+  static thread_local std::vector<const uint8_t*> ptrs;
+  ptrs.resize(n);
+  for (size_t i = 0; i < n; ++i) ptrs[i] = in + offsets[i];
+  phant_keccak256_ptrs_fast(ptrs.data(), lens, n, out);
 }
 
 }  // extern "C"

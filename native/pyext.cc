@@ -40,6 +40,9 @@ int phant_engine_scan_ptrs(void*, const uint8_t* const*, const uint32_t*,
 int64_t phant_engine_commit_ptrs(void*, const uint8_t* const*,
                                  const uint32_t*, uint64_t, int64_t*,
                                  const uint32_t*, uint64_t, const uint8_t*);
+int64_t phant_engine_commit_hash_ptrs(void*, const uint8_t* const*,
+                                      const uint32_t*, uint64_t, int64_t*,
+                                      const uint32_t*, uint64_t);
 int phant_engine_verdict(void*, const int64_t*, const uint64_t*, uint64_t,
                          const uint8_t*, uint8_t*);
 }
@@ -206,6 +209,32 @@ PyObject* Engine_scan(EngineObject* self, PyObject* witnesses) {
                        (unsigned long long)n);
 }
 
+// finish_native() -> verdict bytes; novel nodes are hashed IN C through
+// the fast keccak batch — the zero-Python-round-trip path the engine
+// takes when the routed hashing backend is the host.
+PyObject* Engine_finish_native(EngineObject* self, PyObject*) {
+  if (!self->have_batch) {
+    PyErr_SetString(PyExc_RuntimeError, "finish_native() without a batch");
+    return nullptr;
+  }
+  if (self->n_novel) {
+    phant_engine_commit_hash_ptrs(self->eng, self->ptrs->data(),
+                                  self->lens->data(), self->ptrs->size(),
+                                  self->rows->data(),
+                                  self->novel_idx->data(), self->n_novel);
+  }
+  const uint64_t n_blocks = self->block_offs->size() - 1;
+  PyObject* out = PyBytes_FromStringAndSize(nullptr,
+                                            static_cast<Py_ssize_t>(n_blocks));
+  if (!out) return nullptr;
+  phant_engine_verdict(self->eng, self->rows->data(),
+                       self->block_offs->data(), n_blocks,
+                       self->roots->data(),
+                       reinterpret_cast<uint8_t*>(PyBytes_AS_STRING(out)));
+  clear_batch(self);
+  return out;
+}
+
 // finish(digests_or_None) -> verdict bytes (one 0/1 byte per block)
 PyObject* Engine_finish(EngineObject* self, PyObject* digests_obj) {
   if (!self->have_batch) {
@@ -262,6 +291,8 @@ PyMethodDef Engine_methods[] = {
      "scan(witnesses) -> (novel, miss, total)"},
     {"finish", reinterpret_cast<PyCFunction>(Engine_finish), METH_O,
      "finish(digests|None) -> verdict bytes"},
+    {"finish_native", reinterpret_cast<PyCFunction>(Engine_finish_native),
+     METH_NOARGS, "finish with in-C keccak of the novel nodes"},
     {"flush", reinterpret_cast<PyCFunction>(Engine_flush), METH_NOARGS,
      "drop the interned generation"},
     {"nodes", reinterpret_cast<PyCFunction>(Engine_nodes), METH_NOARGS,

@@ -445,22 +445,53 @@ class WitnessEngine:
 
     def _verify_ext(self, witnesses):
         """Two-call scan/finish protocol against the CPython extension
-        driver — no batch assembly on the Python side at all. Hashing of
-        novel nodes stays here so the backend route applies identically."""
+        driver — no batch assembly on the Python side at all. When the
+        hashing route is provably the host (no hasher override, no device
+        floor, and the offload gate cannot fire), the novel nodes hash
+        inside the extension (finish_native) with zero Python round trip;
+        otherwise the novel list comes back here so the backend route
+        applies identically to every core."""
         st = self._ext_core
         novel, miss, total = st.scan(witnesses)
-        if novel:
-            if st.nodes() + len(novel) > self._max_nodes and st.nodes():
+        n_novel = len(novel)
+        if n_novel:
+            if st.nodes() + n_novel > self._max_nodes and st.nodes():
                 self.stats["evictions"] += 1
                 st.flush()
                 novel, miss, total = st.scan(witnesses)
-            digests = self._hash_batch(novel)
-            self.stats["hashed"] += len(novel)
-            verdict = st.finish(b"".join(digests))
+                n_novel = len(novel)
+            if self._native_route_certain():
+                self.stats["hashed"] += n_novel
+                self.stats["native_batches"] = (
+                    self.stats.get("native_batches", 0) + 1
+                )
+                verdict = st.finish_native()
+            else:
+                digests = self._hash_batch(novel)
+                self.stats["hashed"] += n_novel
+                verdict = st.finish(b"".join(digests))
         else:
             verdict = st.finish(None)
         self.stats["hits"] += total - miss
         return np.frombuffer(verdict, np.uint8).astype(bool)
+
+    def _native_route_certain(self) -> bool:
+        """True when _hash_batch could only ever pick the native hasher —
+        then finish_native may hash in C without consulting the route. Any
+        override (bench hasher, device floor) or a cost model that could
+        favor the device falls back to the Python-visible path."""
+        if self._hasher is not None or self._device_batch_floor >= 0:
+            return False
+        from phant_tpu.backend import (
+            DEVICE_HASH_BPS,
+            NATIVE_HASH_BPS,
+            crypto_backend,
+        )
+
+        if crypto_backend() != "tpu":
+            return True
+        # tpu backend: only safe when the gate is structurally closed
+        return DEVICE_HASH_BPS <= NATIVE_HASH_BPS
 
     def _verify_native(self, witnesses, all_nodes, counts, n_blocks):
         """Scan/hash/commit/verdict against the C++ core. The hashing of

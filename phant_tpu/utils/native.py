@@ -394,7 +394,12 @@ def load_engine_ext():
         try:
             import sysconfig
 
-            srcs = [_NATIVE_DIR / "pyext.cc", _NATIVE_DIR / "engine.cc"]
+            # keccak.cc backs the engine's finish_native in-C hashing
+            srcs = [
+                _NATIVE_DIR / "pyext.cc",
+                _NATIVE_DIR / "engine.cc",
+                _NATIVE_DIR / "keccak.cc",
+            ]
             _BUILD_DIR.mkdir(exist_ok=True)
             if not _EXT_PATH.exists() or any(
                 s.stat().st_mtime > _EXT_PATH.stat().st_mtime for s in srcs
