@@ -209,20 +209,8 @@ PyObject* Engine_scan(EngineObject* self, PyObject* witnesses) {
                        (unsigned long long)n);
 }
 
-// finish_native() -> verdict bytes; novel nodes are hashed IN C through
-// the fast keccak batch — the zero-Python-round-trip path the engine
-// takes when the routed hashing backend is the host.
-PyObject* Engine_finish_native(EngineObject* self, PyObject*) {
-  if (!self->have_batch) {
-    PyErr_SetString(PyExc_RuntimeError, "finish_native() without a batch");
-    return nullptr;
-  }
-  if (self->n_novel) {
-    phant_engine_commit_hash_ptrs(self->eng, self->ptrs->data(),
-                                  self->lens->data(), self->ptrs->size(),
-                                  self->rows->data(),
-                                  self->novel_idx->data(), self->n_novel);
-  }
+// Shared tail of both finish paths: per-block verdicts + batch reset.
+PyObject* verdict_and_clear(EngineObject* self) {
   const uint64_t n_blocks = self->block_offs->size() - 1;
   PyObject* out = PyBytes_FromStringAndSize(nullptr,
                                             static_cast<Py_ssize_t>(n_blocks));
@@ -233,6 +221,29 @@ PyObject* Engine_finish_native(EngineObject* self, PyObject*) {
                        reinterpret_cast<uint8_t*>(PyBytes_AS_STRING(out)));
   clear_batch(self);
   return out;
+}
+
+// finish_native() -> verdict bytes; novel nodes are hashed IN C through
+// the fast keccak batch — the zero-Python-round-trip path the engine
+// takes when the routed hashing backend is the host.
+PyObject* Engine_finish_native(EngineObject* self, PyObject*) {
+  if (!self->have_batch) {
+    PyErr_SetString(PyExc_RuntimeError, "finish_native() without a batch");
+    return nullptr;
+  }
+  if (self->n_novel) {
+    // the commit touches only raw pointers pinned by `keep` — release
+    // the GIL so a big novel batch (startup / post-eviction: tens of MB
+    // of keccak) does not stall the Engine API's other serving threads
+    // (engine-level exclusion is WitnessEngine._lock, already held)
+    Py_BEGIN_ALLOW_THREADS
+    phant_engine_commit_hash_ptrs(self->eng, self->ptrs->data(),
+                                  self->lens->data(), self->ptrs->size(),
+                                  self->rows->data(),
+                                  self->novel_idx->data(), self->n_novel);
+    Py_END_ALLOW_THREADS
+  }
+  return verdict_and_clear(self);
 }
 
 // finish(digests_or_None) -> verdict bytes (one 0/1 byte per block)
@@ -260,16 +271,7 @@ PyObject* Engine_finish(EngineObject* self, PyObject* digests_obj) {
                              self->n_novel,
                              reinterpret_cast<const uint8_t*>(dbuf));
   }
-  const uint64_t n_blocks = self->block_offs->size() - 1;
-  PyObject* out = PyBytes_FromStringAndSize(nullptr,
-                                            static_cast<Py_ssize_t>(n_blocks));
-  if (!out) return nullptr;
-  phant_engine_verdict(self->eng, self->rows->data(),
-                       self->block_offs->data(), n_blocks,
-                       self->roots->data(),
-                       reinterpret_cast<uint8_t*>(PyBytes_AS_STRING(out)));
-  clear_batch(self);
-  return out;
+  return verdict_and_clear(self);
 }
 
 PyObject* Engine_flush(EngineObject* self, PyObject*) {

@@ -151,14 +151,22 @@ NATIVE_HASH_BPS = 300e6
 DEVICE_HASH_BPS = 110e6
 
 
+def device_offload_possible() -> bool:
+    """Could device_offload_pays() EVER return True under the current
+    cost model? False while the device hash term alone exceeds the native
+    cost — the single predicate both the gate's short-circuit and the
+    engine's finish_native fast path key on (one definition, so they
+    cannot diverge if the model is reworked)."""
+    return DEVICE_HASH_BPS > NATIVE_HASH_BPS
+
+
 def device_offload_pays(nbytes: int) -> bool:
     """Shared offload gate for byte-dense hashing work (witness novel-node
     batches, trie-root plans): ship only if upload + round trip + device
     hash beats hashing the same bytes natively on the host. Callers must
     check the crypto backend BEFORE calling — this probes the device link."""
-    if DEVICE_HASH_BPS <= NATIVE_HASH_BPS:
-        # the device hash term alone already exceeds the native cost; no
-        # link speed can make the inequality hold, so skip the probe
+    if not device_offload_possible():
+        # no link speed can make the inequality hold; skip the probe
         return False
     up_bps, rtt = device_link_profile()
     return nbytes / up_bps + rtt + nbytes / DEVICE_HASH_BPS < nbytes / NATIVE_HASH_BPS

@@ -482,16 +482,12 @@ class WitnessEngine:
         favor the device falls back to the Python-visible path."""
         if self._hasher is not None or self._device_batch_floor >= 0:
             return False
-        from phant_tpu.backend import (
-            DEVICE_HASH_BPS,
-            NATIVE_HASH_BPS,
-            crypto_backend,
-        )
+        from phant_tpu.backend import crypto_backend, device_offload_possible
 
         if crypto_backend() != "tpu":
             return True
         # tpu backend: only safe when the gate is structurally closed
-        return DEVICE_HASH_BPS <= NATIVE_HASH_BPS
+        return not device_offload_possible()
 
     def _verify_native(self, witnesses, all_nodes, counts, n_blocks):
         """Scan/hash/commit/verdict against the C++ core. The hashing of
@@ -563,7 +559,13 @@ class WitnessEngine:
 
     def stats_snapshot(self) -> dict:
         """Counters + derived cache-effectiveness numbers (the public
-        surface behind the phant_witnessEngineStats RPC)."""
+        surface behind the phant_witnessEngineStats RPC). Takes the engine
+        lock: finish_native releases the GIL mid-commit, so an unlocked
+        read could otherwise observe the native tables mid-mutation."""
+        with self._lock:
+            return self._stats_snapshot_locked()
+
+    def _stats_snapshot_locked(self) -> dict:
         st = dict(self.stats)
         seen = st.get("hashed", 0) + st.get("hits", 0)
         st["hit_rate"] = round(st.get("hits", 0) / seen, 4) if seen else 0.0
