@@ -199,14 +199,24 @@ PyObject* Engine_scan(EngineObject* self, PyObject* witnesses) {
   // the novel list shares the existing bytes objects (no copies) — they
   // are alive via `keep` and the INCREF here
   PyObject* novel = PyList_New(static_cast<Py_ssize_t>(counts[1]));
-  if (!novel) return nullptr;
+  if (!novel) {
+    clear_batch(self);  // don't leave a half-built batch retained on OOM
+    return nullptr;
+  }
   for (uint64_t k = 0; k < counts[1]; ++k) {
     PyObject* nb = node_objs[(*self->novel_idx)[k]];
     Py_INCREF(nb);
     PyList_SET_ITEM(novel, static_cast<Py_ssize_t>(k), nb);
   }
-  return Py_BuildValue("(NKK)", novel, (unsigned long long)counts[0],
-                       (unsigned long long)n);
+  PyObject* ret = Py_BuildValue("(NKK)", novel, (unsigned long long)counts[0],
+                                (unsigned long long)n);
+  if (!ret) {
+    // "N" args are consumed by Py_BuildValue even on failure (CPython
+    // modsupport.c releases them so they don't leak) — only the batch
+    // state needs unwinding here, a DECREF would double-release `novel`
+    clear_batch(self);
+  }
+  return ret;
 }
 
 // Shared tail of both finish paths: per-block verdicts + batch reset.

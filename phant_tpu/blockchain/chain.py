@@ -552,7 +552,9 @@ class Blockchain:
             nonce = acct.nonce if acct else 0
             if nonce != auth.nonce:
                 continue
-            if not state.is_empty(authority):
+            # refund keys on trie PRESENCE (EELS `account_exists`), not
+            # non-emptiness: an existing-but-empty authority still refunds
+            if acct is not None:
                 refund += G.PER_EMPTY_ACCOUNT_COST - G.PER_AUTH_BASE_COST
             if auth.address == b"\x00" * 20:
                 state.set_code(authority, b"")  # clear the delegation

@@ -194,7 +194,11 @@ class Evm:
         # the tx top level) — resolution here is charge-free. This is the
         # single code-fetch point for both backends (the native core's
         # nested calls re-enter here via the host `call` callback), so
-        # delegation behaves identically everywhere.
+        # delegation behaves identically everywhere. INVARIANT: every
+        # entry path into execute_message must have already charged AND
+        # warmed the delegate (chain.py tx top level; CALL family via
+        # delegation_access_cost) — a new entry path that skips that gets
+        # a silent free warm-add here.
         if self.env.revision >= REVISION_PRAGUE and G.is_delegation_designator(
             code
         ):

@@ -381,7 +381,7 @@ def ecrecover_kernel(e, r, s, parity):
         u32 words (address = bytes 12..31).
       valid: (B,) bool — r/s in range, x on curve, result not at infinity.
     """
-    from phant_tpu.ops.keccak_jax import keccak256_chunked
+    from phant_tpu.ops.keccak_jax import keccak256_chunked_auto
 
     B = r.shape[0]
     # varying-axes-safe zero (see _pow_fixed): shard_map scan carries must
@@ -456,7 +456,7 @@ def ecrecover_kernel(e, r, s, parity):
     words = words.at[:, 0, 8:16].set(_be_words(qy))
     words = words.at[:, 0, 16].set(jnp.uint32(0x00000001))  # keccak 0x01 pad
     words = words.at[:, 0, 33].set(jnp.uint32(0x80000000))  # final 0x80
-    digest = keccak256_chunked(words, jnp.ones((B,), jnp.int32), max_chunks=1)
+    digest = keccak256_chunked_auto(words, jnp.ones((B,), jnp.int32), max_chunks=1)
     return digest, valid
 
 
@@ -619,7 +619,7 @@ def ecrecover_kernel_glv(r, parity, mags, signs):
     curve membership on-device but cannot see s — `valid` does NOT cover an
     out-of-range s, whose split packs to garbage.
     """
-    from phant_tpu.ops.keccak_jax import keccak256_chunked
+    from phant_tpu.ops.keccak_jax import keccak256_chunked_auto
 
     B = r.shape[0]
     zero16 = r ^ r
@@ -746,7 +746,7 @@ def ecrecover_kernel_glv(r, parity, mags, signs):
     words = words.at[:, 0, 8:16].set(_be_words(qy))
     words = words.at[:, 0, 16].set(jnp.uint32(0x00000001))
     words = words.at[:, 0, 33].set(jnp.uint32(0x80000000))
-    digest = keccak256_chunked(words, jnp.ones((B,), jnp.int32), max_chunks=1)
+    digest = keccak256_chunked_auto(words, jnp.ones((B,), jnp.int32), max_chunks=1)
     return digest, valid, degenerate
 
 
@@ -795,9 +795,14 @@ def ecrecover_batch_async(
                 out[i] = None
     if not device_idx:
         return lambda: out
-    if os.environ.get("PHANT_ECRECOVER_KERNEL", "glv") == "shamir":
-        return _dispatch_shamir(out, device_idx, msg_hashes, rs, ss, recovery_ids)
-    return _dispatch_glv(out, device_idx, msg_hashes, rs, ss, recovery_ids)
+    # default = the measured winner: BENCH r4 on a v5e-1 clocked the Shamir
+    # interleaved ladder at 5474.5 recoveries/s vs 2666.2/s for the GLV
+    # ladder (the endomorphism split halves the ladder length but its extra
+    # inversions + wider per-step muxing cost more than it saves at these
+    # batch shapes) — GLV stays selectable for A/B runs
+    if os.environ.get("PHANT_ECRECOVER_KERNEL", "shamir") == "glv":
+        return _dispatch_glv(out, device_idx, msg_hashes, rs, ss, recovery_ids)
+    return _dispatch_shamir(out, device_idx, msg_hashes, rs, ss, recovery_ids)
 
 
 def _bucket_pad(n: int) -> int:
@@ -810,8 +815,8 @@ def _bucket_pad(n: int) -> int:
 
 
 def _dispatch_shamir(out, device_idx, msg_hashes, rs, ss, recovery_ids):
-    """The original 256-step Shamir kernel (kept for the sharded mesh path
-    and A/B measurement; PHANT_ECRECOVER_KERNEL=shamir)."""
+    """The 256-step Shamir interleaved ladder — the production default
+    (BENCH r4: 5474.5/s vs GLV 2666.2/s on a v5e-1)."""
     pad = _bucket_pad(len(device_idx)) - len(device_idx)
     e = ints_to_limbs(
         [int.from_bytes(msg_hashes[i], "big") for i in device_idx] + [1] * pad

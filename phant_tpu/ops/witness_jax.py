@@ -23,7 +23,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from phant_tpu.crypto.keccak import RATE
-from phant_tpu.ops.keccak_jax import keccak256_chunked
+from phant_tpu.ops.keccak_jax import keccak256_chunked_auto
 
 # Bucket bound for witness nodes: RLP trie nodes are <= 576B (BASELINE.md),
 # and 576 < 5 * 136. Shared by bench.py / __graft_entry__.py / tests.
@@ -60,7 +60,7 @@ def _digests_from_rows(data, lens, *, max_chunks: int):
     # u8 -> little-endian u32 lanes
     b = padded.reshape(padded.shape[0], max_chunks, RATE // 4, 4).astype(jnp.uint32)
     words = b[..., 0] | (b[..., 1] << 8) | (b[..., 2] << 16) | (b[..., 3] << 24)
-    return keccak256_chunked(words, nchunks, max_chunks=max_chunks)
+    return keccak256_chunked_auto(words, nchunks, max_chunks=max_chunks)
 
 
 @functools.partial(jax.jit, static_argnames=("max_chunks",))
