@@ -59,6 +59,7 @@ class BlockExecutionResult:
     gas_used: int
     receipts: List[Receipt]
     logs_bloom: bytes
+    requests_hash: Optional[bytes] = None  # EIP-7685 (Prague blocks only)
 
 
 class Blockchain:
@@ -237,6 +238,18 @@ class Blockchain:
             raise BlockError("receipts root mismatch")
         if result.logs_bloom != header.logs_bloom:
             raise BlockError("logs bloom mismatch")
+        if result.requests_hash is not None:
+            # EIP-7685: a Prague block must commit to its requests
+            if header.requests_hash is None:
+                raise BlockError("prague header missing requests_hash")
+            if result.requests_hash != header.requests_hash:
+                raise BlockError(
+                    f"requests hash mismatch: computed "
+                    f"{result.requests_hash.hex()}, header "
+                    f"{header.requests_hash.hex()}"
+                )
+        elif header.requests_hash is not None:
+            raise BlockError("requests_hash before prague")
         if self.verify_state_root:
             # beyond reference (TODO-disabled at blockchain.zig:83-85)
             computed = self.state.state_root()
@@ -419,11 +432,83 @@ class Blockchain:
                 if acct is not None and acct.is_empty():
                     self.state.delete_account(wd.address)
 
+        # EIP-7685 requests surface (Prague): deposits parsed from this
+        # block's receipts, withdrawal/consolidation requests dequeued by
+        # end-of-block system calls (phant_tpu/blockchain/requests.py)
+        requests_hash = None
+        if self.prague_active(header):
+            requests_hash = self._collect_requests(receipts, header)
+
         return BlockExecutionResult(
             gas_used=cumulative_gas,
             receipts=receipts,
             logs_bloom=logs_bloom(all_logs),
+            requests_hash=requests_hash,
         )
+
+    def _collect_requests(self, receipts, header: BlockHeader) -> bytes:
+        from phant_tpu.blockchain import requests as req
+
+        try:
+            deposits = req.extract_deposit_requests(receipts)
+        except req.RequestsError as e:
+            raise BlockError(str(e)) from e
+        withdrawals = self._system_call(req.WITHDRAWAL_REQUEST_ADDRESS, header)
+        consolidations = self._system_call(
+            req.CONSOLIDATION_REQUEST_ADDRESS, header
+        )
+        items = []
+        if deposits:
+            items.append(req.DEPOSIT_REQUEST_TYPE + deposits)
+        if withdrawals:
+            items.append(req.WITHDRAWAL_REQUEST_TYPE + withdrawals)
+        if consolidations:
+            items.append(req.CONSOLIDATION_REQUEST_TYPE + consolidations)
+        return req.compute_requests_hash(items)
+
+    def _system_call(self, target: bytes, header: BlockHeader) -> bytes:
+        """EIP-7002/7251 end-of-block system call: caller = the system
+        address, 30M gas, feeless, outside block-gas accounting; the
+        output bytes ARE the request data.  A missing predeploy or a
+        failing call invalidates the block (the requests cannot be
+        proven absent)."""
+        from phant_tpu.blockchain import requests as req
+        from phant_tpu.evm.interpreter import Evm
+        from phant_tpu.evm.message import REVISION_PRAGUE, Environment, Message
+
+        state = self.state
+        if not state.get_code(target):
+            raise BlockError(f"missing system contract 0x{target.hex()}")
+        state.start_tx()  # fresh warm sets / refund / logs for the call
+        env = Environment(
+            state=state,
+            origin=req.SYSTEM_ADDRESS,
+            coinbase=header.fee_recipient,
+            block_number=header.block_number,
+            gas_limit=header.gas_limit,
+            gas_price=0,
+            timestamp=header.timestamp,
+            prev_randao=header.prev_randao,
+            base_fee=header.base_fee_per_gas or 0,
+            chain_id=self.chain_id,
+            block_hash_fn=self.fork.get_block_hash,
+            revision=REVISION_PRAGUE,
+        )
+        evm = Evm(env)
+        result = evm.execute_message(
+            Message(
+                caller=req.SYSTEM_ADDRESS,
+                target=target,
+                value=0,
+                data=b"",
+                gas=req.SYSTEM_CALL_GAS,
+            )
+        )
+        if not result.success:
+            raise BlockError(
+                f"system call to 0x{target.hex()} failed: {result.error}"
+            )
+        return result.output
 
     # ------------------------------------------------------------------
 
@@ -635,7 +720,7 @@ class Blockchain:
         # (reference: blockchain.zig:293-301, params.zig:19-29)
         state.access_address(sender)
         state.access_address(header.fee_recipient)
-        for addr in precompile_addresses():
+        for addr in precompile_addresses(revision):
             state.access_address(addr)
         if tx.to is not None:
             state.access_address(tx.to)

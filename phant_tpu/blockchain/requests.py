@@ -1,0 +1,103 @@
+"""EIP-7685 execution-layer requests (Prague): deposits (EIP-6110),
+withdrawal requests (EIP-7002), consolidations (EIP-7251).
+
+The reference predates the requests fork surface entirely (its Prague
+experiment is only the EIP-2935 BLOCKHASH ring,
+src/blockchain/forks/prague.zig) — this module is fork-mandated
+framework-beyond-reference scope, mirrored on the execution-specs
+semantics:
+
+- deposits are PARSED out of the deposit contract's DepositEvent logs
+  emitted during normal tx execution (no system call);
+- withdrawal + consolidation requests are DEQUEUED by end-of-block system
+  calls to their predeploy contracts (caller = the 0xff..fe system
+  address, 30M gas, no fee, no block-gas accounting); the contracts'
+  runtime code ships with the chain state (genesis/fixture pre-state),
+  not with this client;
+- the block commits to them via header.requests_hash =
+  sha256(concat(sha256(type || data) for each NON-EMPTY request list)).
+"""
+
+from __future__ import annotations
+
+import hashlib
+from typing import List, Sequence
+
+from phant_tpu.crypto.keccak import keccak256
+
+SYSTEM_ADDRESS = bytes.fromhex("fffffffffffffffffffffffffffffffffffffffe")
+SYSTEM_CALL_GAS = 30_000_000
+
+# mainnet beacon-chain deposit contract (EIP-6110); spec-test chains use
+# the same address for their mock deposit contracts
+DEPOSIT_CONTRACT_ADDRESS = bytes.fromhex("00000000219ab540356cbb839cbe05303d7705fa")
+DEPOSIT_EVENT_SIGNATURE_HASH = keccak256(b"DepositEvent(bytes,bytes,bytes,bytes,bytes)")
+
+# EIP-7002 / EIP-7251 predeploys
+WITHDRAWAL_REQUEST_ADDRESS = bytes.fromhex("00000961ef480eb55e80d19ad83579a64c007002")
+CONSOLIDATION_REQUEST_ADDRESS = bytes.fromhex("0000bbddc7ce488642fb579f8b00f3a590007251")
+
+DEPOSIT_REQUEST_TYPE = b"\x00"
+WITHDRAWAL_REQUEST_TYPE = b"\x01"
+CONSOLIDATION_REQUEST_TYPE = b"\x02"
+
+
+class RequestsError(ValueError):
+    """Malformed request surface => the block is invalid."""
+
+
+def parse_deposit_event_data(data: bytes) -> bytes:
+    """DepositEvent(bytes,bytes,bytes,bytes,bytes) ABI data -> the 192-byte
+    deposit request (pubkey48 || withdrawal_credentials32 || amount8 ||
+    signature96 || index8).  The layout is rigidly validated (EIP-6110:
+    anything off-shape invalidates the block, it cannot be skipped)."""
+    if len(data) != 576:
+        raise RequestsError(f"deposit event data length {len(data)} != 576")
+
+    def word(i: int) -> int:
+        return int.from_bytes(data[32 * i : 32 * (i + 1)], "big")
+
+    # head: offsets of the five dynamic fields
+    if (word(0), word(1), word(2), word(3), word(4)) != (160, 256, 320, 384, 512):
+        raise RequestsError("deposit event field offsets malformed")
+    # length prefix of each tail section
+    if word(5) != 48:  # pubkey
+        raise RequestsError("deposit pubkey length != 48")
+    if data[256:288] != (32).to_bytes(32, "big"):
+        raise RequestsError("deposit withdrawal_credentials length != 32")
+    if data[320:352] != (8).to_bytes(32, "big"):
+        raise RequestsError("deposit amount length != 8")
+    if data[384:416] != (96).to_bytes(32, "big"):
+        raise RequestsError("deposit signature length != 96")
+    if data[512:544] != (8).to_bytes(32, "big"):
+        raise RequestsError("deposit index length != 8")
+    pubkey = data[192:240]
+    withdrawal_credentials = data[288:320]
+    amount = data[352:360]
+    signature = data[416:512]
+    index = data[544:552]
+    return pubkey + withdrawal_credentials + amount + signature + index
+
+
+def extract_deposit_requests(receipts: Sequence) -> bytes:
+    """Concatenated deposit requests from the block's receipts, in log
+    order (EIP-6110)."""
+    out = []
+    for receipt in receipts:
+        for log in receipt.logs:
+            if (
+                log.address == DEPOSIT_CONTRACT_ADDRESS
+                and len(log.topics) >= 1
+                and log.topics[0] == DEPOSIT_EVENT_SIGNATURE_HASH
+            ):
+                out.append(parse_deposit_event_data(log.data))
+    return b"".join(out)
+
+
+def compute_requests_hash(requests: List[bytes]) -> bytes:
+    """EIP-7685: sha256 over the sha256 of each request item (each item =
+    type byte || data; empty-data items must already be excluded)."""
+    m = hashlib.sha256()
+    for req in requests:
+        m.update(hashlib.sha256(req).digest())
+    return m.digest()

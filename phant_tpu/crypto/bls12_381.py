@@ -1,0 +1,613 @@
+"""BLS12-381: field tower, G1/G2 groups, optimal-ate pairing check.
+
+Serves the EIP-4844 point-evaluation precompile (0x0A) and the EIP-2537
+Prague precompiles (G1/G2 add, MSM, pairing).  The reference has neither
+(its Cancun/Prague support predates both EIPs; scope anchor:
+src/blockchain/params.zig:30-39 enumerates its precompile set) — this is
+framework-beyond-reference surface required by the advertised forks.
+
+Pure Python by design: these precompiles are cold control-plane work (a
+handful of calls per block at most) while the hot loop (keccak/ecrecover/
+trie) runs on the device kernels.  Clarity and auditability beat speed
+here.
+
+Implementation notes:
+- Tower: Fq2 = Fq[u]/(u^2+1); Fq6 = Fq2[v]/(v^3 - (u+1));
+  Fq12 = Fq6[w]/(w^2 - v).
+- G2 points live on the twist E'(Fq2): y^2 = x^3 + 4(u+1); the Miller
+  loop untwists into E(Fq12) via x -> x*w^-2 (w^-2 = v^-1 w^0... computed
+  as a true Fq12 inverse), y -> y*w^-3.
+- pairing_check evaluates prod_i e(P_i, Q_i) == 1 with one shared final
+  exponentiation; all consumers (KZG verify, 2537 PAIRING) only ever need
+  that boolean, which is invariant under the exact pairing normalization
+  (any fixed power of the canonical pairing gives identical verdicts), so
+  the loop sign convention for the negative BLS parameter need not match
+  other libraries element-for-element — bilinearity and non-degeneracy
+  are what the tests pin.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+# field modulus
+P = 0x1A0111EA397FE69A4B1BA7B6434BACD764774B84F38512BF6730D2A0F6B0F6241EABFFFEB153FFFFB9FEFFFFFFFFAAAB
+# subgroup order (= the BLS_MODULUS of EIP-4844)
+R = 0x73EDA753299D7D483339D80809A1D80553BDA402FFFE5BFEFFFFFFFF00000001
+# BLS parameter x (negative); |x| drives the Miller loop
+X_ABS = 0xD201000000010000
+
+G1_X = 0x17F1D3A73197D7942695638C4FA9AC0FC3688C4F9774B905A14E3A3F171BAC586C55E83FF97A1AEFFB3AF00ADB22C6BB
+G1_Y = 0x08B3F481E3AAA0F1A09E30ED741D8AE4FCF5E095D5D00AF600DB18CB2C04B3EDD03CC744A2888AE40CAA232946C5E7E1
+
+G2_X = (
+    0x024AA2B2F08F0A91260805272DC51051C6E47AD4FA403B02B4510B647AE3D1770BAC0326A805BBEFD48056C8C121BDB8,
+    0x13E02B6052719F607DACD3A088274F65596BD0D09920B61AB5DA61BBDC7F5049334CF11213945D57E5AC7D055D042B7E,
+)
+G2_Y = (
+    0x0CE5D527727D6E118CC9CDC6DA2E351AADFD9BAA8CBDD3A76D429A695160D12C923AC9CC3BACA289E193548608B82801,
+    0x0606C4A02EA734CC32ACD2B02BC28B99CB3E287E85A763AF267492AB572E99AB3F370D275CEC1DA1AAA9075FF05F79BE,
+)
+
+# ---------------------------------------------------------------------------
+# Fq2 as (c0, c1) tuples: c0 + c1*u with u^2 = -1
+# ---------------------------------------------------------------------------
+
+Fq2 = Tuple[int, int]
+FQ2_ZERO: Fq2 = (0, 0)
+FQ2_ONE: Fq2 = (1, 0)
+XI: Fq2 = (1, 1)  # the sextic non-residue u + 1
+
+
+def fq2_add(a: Fq2, b: Fq2) -> Fq2:
+    return ((a[0] + b[0]) % P, (a[1] + b[1]) % P)
+
+
+def fq2_sub(a: Fq2, b: Fq2) -> Fq2:
+    return ((a[0] - b[0]) % P, (a[1] - b[1]) % P)
+
+
+def fq2_neg(a: Fq2) -> Fq2:
+    return (-a[0] % P, -a[1] % P)
+
+
+def fq2_mul(a: Fq2, b: Fq2) -> Fq2:
+    # (a0 + a1 u)(b0 + b1 u) = (a0b0 - a1b1) + (a0b1 + a1b0) u
+    t0 = a[0] * b[0]
+    t1 = a[1] * b[1]
+    t2 = (a[0] + a[1]) * (b[0] + b[1])
+    return ((t0 - t1) % P, (t2 - t0 - t1) % P)
+
+
+def fq2_mul_int(a: Fq2, k: int) -> Fq2:
+    return (a[0] * k % P, a[1] * k % P)
+
+
+def fq2_sq(a: Fq2) -> Fq2:
+    return fq2_mul(a, a)
+
+
+def fq2_inv(a: Fq2) -> Fq2:
+    # 1/(a0 + a1 u) = (a0 - a1 u)/(a0^2 + a1^2)
+    norm = (a[0] * a[0] + a[1] * a[1]) % P
+    inv = pow(norm, P - 2, P)
+    return (a[0] * inv % P, -a[1] * inv % P)
+
+
+def fq2_is_zero(a: Fq2) -> bool:
+    return a[0] % P == 0 and a[1] % P == 0
+
+
+# ---------------------------------------------------------------------------
+# Fq6 as (c0, c1, c2): c0 + c1 v + c2 v^2 with v^3 = XI
+# ---------------------------------------------------------------------------
+
+Fq6 = Tuple[Fq2, Fq2, Fq2]
+FQ6_ZERO: Fq6 = (FQ2_ZERO, FQ2_ZERO, FQ2_ZERO)
+FQ6_ONE: Fq6 = (FQ2_ONE, FQ2_ZERO, FQ2_ZERO)
+
+
+def fq6_add(a: Fq6, b: Fq6) -> Fq6:
+    return (fq2_add(a[0], b[0]), fq2_add(a[1], b[1]), fq2_add(a[2], b[2]))
+
+
+def fq6_sub(a: Fq6, b: Fq6) -> Fq6:
+    return (fq2_sub(a[0], b[0]), fq2_sub(a[1], b[1]), fq2_sub(a[2], b[2]))
+
+
+def fq6_neg(a: Fq6) -> Fq6:
+    return (fq2_neg(a[0]), fq2_neg(a[1]), fq2_neg(a[2]))
+
+
+def fq6_mul(a: Fq6, b: Fq6) -> Fq6:
+    a0, a1, a2 = a
+    b0, b1, b2 = b
+    t0 = fq2_mul(a0, b0)
+    t1 = fq2_mul(a1, b1)
+    t2 = fq2_mul(a2, b2)
+    # c0 = t0 + XI*((a1+a2)(b1+b2) - t1 - t2)
+    c0 = fq2_add(
+        t0,
+        fq2_mul(
+            XI,
+            fq2_sub(
+                fq2_sub(fq2_mul(fq2_add(a1, a2), fq2_add(b1, b2)), t1), t2
+            ),
+        ),
+    )
+    # c1 = (a0+a1)(b0+b1) - t0 - t1 + XI*t2
+    c1 = fq2_add(
+        fq2_sub(fq2_sub(fq2_mul(fq2_add(a0, a1), fq2_add(b0, b1)), t0), t1),
+        fq2_mul(XI, t2),
+    )
+    # c2 = (a0+a2)(b0+b2) - t0 - t2 + t1
+    c2 = fq2_add(
+        fq2_sub(fq2_sub(fq2_mul(fq2_add(a0, a2), fq2_add(b0, b2)), t0), t2), t1
+    )
+    return (c0, c1, c2)
+
+
+def fq6_mul_by_v(a: Fq6) -> Fq6:
+    # v * (c0 + c1 v + c2 v^2) = XI*c2 + c0 v + c1 v^2
+    return (fq2_mul(XI, a[2]), a[0], a[1])
+
+
+def fq6_inv(a: Fq6) -> Fq6:
+    a0, a1, a2 = a
+    t0 = fq2_sub(fq2_sq(a0), fq2_mul(XI, fq2_mul(a1, a2)))
+    t1 = fq2_sub(fq2_mul(XI, fq2_sq(a2)), fq2_mul(a0, a1))
+    t2 = fq2_sub(fq2_sq(a1), fq2_mul(a0, a2))
+    denom = fq2_add(
+        fq2_mul(a0, t0),
+        fq2_mul(XI, fq2_add(fq2_mul(a2, t1), fq2_mul(a1, t2))),
+    )
+    dinv = fq2_inv(denom)
+    return (fq2_mul(t0, dinv), fq2_mul(t1, dinv), fq2_mul(t2, dinv))
+
+
+# ---------------------------------------------------------------------------
+# Fq12 as (c0, c1): c0 + c1 w with w^2 = v
+# ---------------------------------------------------------------------------
+
+Fq12 = Tuple[Fq6, Fq6]
+FQ12_ONE: Fq12 = (FQ6_ONE, FQ6_ZERO)
+
+
+def fq12_add(a: Fq12, b: Fq12) -> Fq12:
+    return (fq6_add(a[0], b[0]), fq6_add(a[1], b[1]))
+
+
+def fq12_sub(a: Fq12, b: Fq12) -> Fq12:
+    return (fq6_sub(a[0], b[0]), fq6_sub(a[1], b[1]))
+
+
+def fq12_mul(a: Fq12, b: Fq12) -> Fq12:
+    t0 = fq6_mul(a[0], b[0])
+    t1 = fq6_mul(a[1], b[1])
+    c0 = fq6_add(t0, fq6_mul_by_v(t1))
+    c1 = fq6_sub(
+        fq6_sub(fq6_mul(fq6_add(a[0], a[1]), fq6_add(b[0], b[1])), t0), t1
+    )
+    return (c0, c1)
+
+
+def fq12_sq(a: Fq12) -> Fq12:
+    return fq12_mul(a, a)
+
+
+def fq12_inv(a: Fq12) -> Fq12:
+    # (a0 + a1 w)^-1 = (a0 - a1 w) / (a0^2 - v a1^2)
+    denom = fq6_sub(fq6_sq_(a[0]), fq6_mul_by_v(fq6_sq_(a[1])))
+    dinv = fq6_inv(denom)
+    return (fq6_mul(a[0], dinv), fq6_neg(fq6_mul(a[1], dinv)))
+
+
+def fq6_sq_(a: Fq6) -> Fq6:
+    return fq6_mul(a, a)
+
+
+def fq12_is_one(a: Fq12) -> bool:
+    c0, c1 = a
+    return (
+        c0[0] == FQ2_ONE
+        and fq2_is_zero(c0[1])
+        and fq2_is_zero(c0[2])
+        and all(fq2_is_zero(x) for x in c1)
+    )
+
+
+def fq12_pow(a: Fq12, e: int) -> Fq12:
+    result = FQ12_ONE
+    base = a
+    while e:
+        if e & 1:
+            result = fq12_mul(result, base)
+        base = fq12_sq(base)
+        e >>= 1
+    return result
+
+
+def fq12_scalar_fq2(c: Fq2) -> Fq12:
+    """Embed an Fq2 scalar into Fq12."""
+    return ((c, FQ2_ZERO, FQ2_ZERO), FQ6_ZERO)
+
+
+# w and its inverse powers, used by the untwist map
+_W: Fq12 = (FQ6_ZERO, (FQ2_ONE, FQ2_ZERO, FQ2_ZERO))
+_W2: Fq12 = ((FQ2_ZERO, FQ2_ONE, FQ2_ZERO), FQ6_ZERO)  # w^2 = v
+_W3: Fq12 = (FQ6_ZERO, (FQ2_ZERO, FQ2_ONE, FQ2_ZERO))  # w^3 = v w
+_W2_INV = fq12_inv(_W2)
+_W3_INV = fq12_inv(_W3)
+
+
+# ---------------------------------------------------------------------------
+# G1: E(Fq): y^2 = x^3 + 4.  Points are (x, y) ints or None for infinity.
+# ---------------------------------------------------------------------------
+
+G1Point = Optional[Tuple[int, int]]
+G1_GEN: G1Point = (G1_X, G1_Y)
+B1 = 4
+
+
+def g1_is_on_curve(pt: G1Point) -> bool:
+    if pt is None:
+        return True
+    x, y = pt
+    return (y * y - (x * x * x + B1)) % P == 0
+
+
+def g1_neg(pt: G1Point) -> G1Point:
+    if pt is None:
+        return None
+    return (pt[0], -pt[1] % P)
+
+
+def g1_add(a: G1Point, b: G1Point) -> G1Point:
+    if a is None:
+        return b
+    if b is None:
+        return a
+    x1, y1 = a
+    x2, y2 = b
+    if x1 == x2:
+        if (y1 + y2) % P == 0:
+            return None
+        lam = (3 * x1 * x1) * pow(2 * y1, P - 2, P) % P
+    else:
+        lam = (y2 - y1) * pow(x2 - x1, P - 2, P) % P
+    x3 = (lam * lam - x1 - x2) % P
+    y3 = (lam * (x1 - x3) - y1) % P
+    return (x3, y3)
+
+
+def g1_mul(pt: G1Point, k: int) -> G1Point:
+    # no implicit mod-R here: g1_in_subgroup relies on multiplying by R
+    if k < 0:
+        return g1_mul(g1_neg(pt), -k)
+    result: G1Point = None
+    addend = pt
+    while k:
+        if k & 1:
+            result = g1_add(result, addend)
+        addend = g1_add(addend, addend)
+        k >>= 1
+    return result
+
+
+def g1_in_subgroup(pt: G1Point) -> bool:
+    """Full check: on curve and r*pt == infinity."""
+    return g1_is_on_curve(pt) and g1_mul(pt, R) is None
+
+
+# ---------------------------------------------------------------------------
+# G2: E'(Fq2): y^2 = x^3 + 4(u+1)
+# ---------------------------------------------------------------------------
+
+G2Point = Optional[Tuple[Fq2, Fq2]]
+G2_GEN: G2Point = (G2_X, G2_Y)
+B2: Fq2 = (4, 4)
+
+
+def g2_is_on_curve(pt: G2Point) -> bool:
+    if pt is None:
+        return True
+    x, y = pt
+    lhs = fq2_sq(y)
+    rhs = fq2_add(fq2_mul(fq2_sq(x), x), B2)
+    return fq2_is_zero(fq2_sub(lhs, rhs))
+
+
+def g2_neg(pt: G2Point) -> G2Point:
+    if pt is None:
+        return None
+    return (pt[0], fq2_neg(pt[1]))
+
+
+def g2_add(a: G2Point, b: G2Point) -> G2Point:
+    if a is None:
+        return b
+    if b is None:
+        return a
+    x1, y1 = a
+    x2, y2 = b
+    if fq2_is_zero(fq2_sub(x1, x2)):
+        if fq2_is_zero(fq2_add(y1, y2)):
+            return None
+        lam = fq2_mul(fq2_mul_int(fq2_sq(x1), 3), fq2_inv(fq2_mul_int(y1, 2)))
+    else:
+        lam = fq2_mul(fq2_sub(y2, y1), fq2_inv(fq2_sub(x2, x1)))
+    x3 = fq2_sub(fq2_sub(fq2_sq(lam), x1), x2)
+    y3 = fq2_sub(fq2_mul(lam, fq2_sub(x1, x3)), y1)
+    return (x3, y3)
+
+
+def g2_mul(pt: G2Point, k: int) -> G2Point:
+    if k < 0:
+        return g2_mul(g2_neg(pt), -k)
+    result: G2Point = None
+    addend = pt
+    while k:
+        if k & 1:
+            result = g2_add(result, addend)
+        addend = g2_add(addend, addend)
+        k >>= 1
+    return result
+
+
+def g2_in_subgroup(pt: G2Point) -> bool:
+    return g2_is_on_curve(pt) and g2_mul(pt, R) is None
+
+
+# ---------------------------------------------------------------------------
+# pairing
+# ---------------------------------------------------------------------------
+
+E12Point = Optional[Tuple[Fq12, Fq12]]
+
+
+def _untwist(pt: G2Point) -> E12Point:
+    """E'(Fq2) -> E(Fq12): (x, y) -> (x w^-2, y w^-3)."""
+    if pt is None:
+        return None
+    x = fq12_mul(fq12_scalar_fq2(pt[0]), _W2_INV)
+    y = fq12_mul(fq12_scalar_fq2(pt[1]), _W3_INV)
+    return (x, y)
+
+
+def _e12_embed_g1(pt: Tuple[int, int]) -> Tuple[Fq12, Fq12]:
+    return (
+        fq12_scalar_fq2((pt[0], 0)),
+        fq12_scalar_fq2((pt[1], 0)),
+    )
+
+
+def _e12_double(a: Tuple[Fq12, Fq12]) -> Tuple[Fq12, Fq12]:
+    x, y = a
+    lam = fq12_mul(
+        fq12_mul(fq12_sq(x), fq12_scalar_fq2((3, 0))),
+        fq12_inv(fq12_mul(y, fq12_scalar_fq2((2, 0)))),
+    )
+    x3 = fq12_sub(fq12_sq(lam), fq12_add(x, x))
+    y3 = fq12_sub(fq12_mul(lam, fq12_sub(x, x3)), y)
+    return (x3, y3)
+
+
+def _e12_add(
+    a: Tuple[Fq12, Fq12], b: Tuple[Fq12, Fq12]
+) -> Tuple[Fq12, Fq12]:
+    x1, y1 = a
+    x2, y2 = b
+    lam = fq12_mul(fq12_sub(y2, y1), fq12_inv(fq12_sub(x2, x1)))
+    x3 = fq12_sub(fq12_sub(fq12_sq(lam), x1), x2)
+    y3 = fq12_sub(fq12_mul(lam, fq12_sub(x1, x3)), y1)
+    return (x3, y3)
+
+
+def _line(
+    r: Tuple[Fq12, Fq12],
+    q: Tuple[Fq12, Fq12],
+    p: Tuple[Fq12, Fq12],
+) -> Fq12:
+    """Evaluate the line through r and q (tangent if r == q) at p."""
+    xr, yr = r
+    xq, yq = q
+    xp, yp = p
+    if fq12_is_eq(xr, xq) and fq12_is_eq(yr, yq):
+        lam = fq12_mul(
+            fq12_mul(fq12_sq(xr), fq12_scalar_fq2((3, 0))),
+            fq12_inv(fq12_mul(yr, fq12_scalar_fq2((2, 0)))),
+        )
+        return fq12_sub(fq12_sub(yp, yr), fq12_mul(lam, fq12_sub(xp, xr)))
+    if fq12_is_eq(xr, xq):
+        # vertical line
+        return fq12_sub(xp, xr)
+    lam = fq12_mul(fq12_sub(yq, yr), fq12_inv(fq12_sub(xq, xr)))
+    return fq12_sub(fq12_sub(yp, yr), fq12_mul(lam, fq12_sub(xp, xr)))
+
+
+def fq12_is_eq(a: Fq12, b: Fq12) -> bool:
+    d = fq12_sub(a, b)
+    return all(fq2_is_zero(c) for c in d[0]) and all(
+        fq2_is_zero(c) for c in d[1]
+    )
+
+
+_X_BITS = bin(X_ABS)[3:]  # msb-first, leading 1 dropped
+
+FINAL_EXP = (P**12 - 1) // R
+
+
+def _miller_loop(q: Tuple[Fq12, Fq12], p: Tuple[Fq12, Fq12]) -> Fq12:
+    """f_{|x|, Q}(P), no final exponentiation."""
+    r = q
+    f = FQ12_ONE
+    for bit in _X_BITS:
+        f = fq12_mul(fq12_sq(f), _line(r, r, p))
+        r = _e12_double(r)
+        if bit == "1":
+            f = fq12_mul(f, _line(r, q, p))
+            r = _e12_add(r, q)
+    return f
+
+
+def pairing_check(pairs: Sequence[Tuple[G1Point, G2Point]]) -> bool:
+    """prod_i e(P_i, Q_i) == 1, with one shared final exponentiation.
+
+    Infinity entries contribute the neutral element and are skipped
+    (matches EIP-2537 PAIRING and the KZG verify equation).  Callers are
+    responsible for curve/subgroup membership checks.
+    """
+    f = FQ12_ONE
+    for g1, g2 in pairs:
+        if g1 is None or g2 is None:
+            continue
+        q = _untwist(g2)
+        p = _e12_embed_g1(g1)
+        f = fq12_mul(f, _miller_loop(q, p))
+    return fq12_is_one(fq12_pow(f, FINAL_EXP))
+
+
+# ---------------------------------------------------------------------------
+# serialization (zcash/EIP-4844 compressed format)
+# ---------------------------------------------------------------------------
+
+
+class PointDecodeError(ValueError):
+    pass
+
+
+def g1_decompress(data: bytes) -> G1Point:
+    """48-byte compressed G1 point -> point, with curve + subgroup check."""
+    if len(data) != 48:
+        raise PointDecodeError("G1 compressed point must be 48 bytes")
+    flags = data[0]
+    if not flags & 0x80:
+        raise PointDecodeError("compression bit not set")
+    infinity = bool(flags & 0x40)
+    sort = bool(flags & 0x20)
+    x = int.from_bytes(bytes([flags & 0x1F]) + data[1:], "big")
+    if infinity:
+        if sort or x != 0:
+            raise PointDecodeError("malformed infinity encoding")
+        return None
+    if x >= P:
+        raise PointDecodeError("x not a canonical field element")
+    y2 = (x * x * x + B1) % P
+    y = pow(y2, (P + 1) // 4, P)
+    if y * y % P != y2:
+        raise PointDecodeError("x not on curve")
+    if (y > P - y) != sort:
+        y = P - y
+    pt = (x, y)
+    if not g1_in_subgroup(pt):
+        raise PointDecodeError("point not in G1 subgroup")
+    return pt
+
+
+def g1_compress(pt: G1Point) -> bytes:
+    if pt is None:
+        return bytes([0xC0]) + bytes(47)
+    x, y = pt
+    flags = 0x80 | (0x20 if y > P - y else 0)
+    raw = x.to_bytes(48, "big")
+    return bytes([raw[0] | flags]) + raw[1:]
+
+
+def g2_decompress(data: bytes) -> G2Point:
+    """96-byte compressed G2 point (c1 || c0 big-endian) with checks."""
+    if len(data) != 96:
+        raise PointDecodeError("G2 compressed point must be 96 bytes")
+    flags = data[0]
+    if not flags & 0x80:
+        raise PointDecodeError("compression bit not set")
+    infinity = bool(flags & 0x40)
+    sort = bool(flags & 0x20)
+    x1 = int.from_bytes(bytes([flags & 0x1F]) + data[1:48], "big")
+    x0 = int.from_bytes(data[48:96], "big")
+    if infinity:
+        if sort or x1 != 0 or x0 != 0:
+            raise PointDecodeError("malformed infinity encoding")
+        return None
+    if x0 >= P or x1 >= P:
+        raise PointDecodeError("x not canonical")
+    x: Fq2 = (x0, x1)
+    y2 = fq2_add(fq2_mul(fq2_sq(x), x), B2)
+    y = fq2_sqrt(y2)
+    if y is None:
+        raise PointDecodeError("x not on curve")
+    if _fq2_lex_larger(y) != sort:
+        y = fq2_neg(y)
+    pt = (x, y)
+    if not g2_in_subgroup(pt):
+        raise PointDecodeError("point not in G2 subgroup")
+    return pt
+
+
+def g2_compress(pt: G2Point) -> bytes:
+    if pt is None:
+        return bytes([0xC0]) + bytes(95)
+    x, y = pt
+    flags = 0x80 | (0x20 if _fq2_lex_larger(y) else 0)
+    raw1 = x[1].to_bytes(48, "big")
+    raw0 = x[0].to_bytes(48, "big")
+    return bytes([raw1[0] | flags]) + raw1[1:] + raw0
+
+
+def _fq2_lex_larger(y: Fq2) -> bool:
+    """Is y lexicographically larger than -y (c1 compared first)?"""
+    ny = fq2_neg(y)
+    return (y[1], y[0]) > (ny[1], ny[0])
+
+
+def fq2_sqrt(a: Fq2) -> Optional[Fq2]:
+    """Square root in Fq2 (p^2 ≡ 9 mod 16; use the p ≡ 3 mod 4 trick on
+    the tower): candidate = a^((p^2+7)/16) style algorithms are fussy —
+    use the simple complex method: sqrt(a0 + a1 u) via Fq square roots."""
+    a0, a1 = a[0] % P, a[1] % P
+    if a1 == 0:
+        # sqrt of a base-field element: either sqrt(a0) or sqrt(-a0)*u
+        s = pow(a0, (P + 1) // 4, P)
+        if s * s % P == a0:
+            return (s, 0)
+        s = pow(-a0 % P, (P + 1) // 4, P)
+        if s * s % P == (-a0) % P:
+            return (0, s)
+        return None
+    # norm = a0^2 + a1^2; alpha = sqrt(norm) in Fq (if it exists)
+    norm = (a0 * a0 + a1 * a1) % P
+    alpha = pow(norm, (P + 1) // 4, P)
+    if alpha * alpha % P != norm:
+        return None
+    # x0^2 = (a0 + alpha)/2 or (a0 - alpha)/2
+    inv2 = pow(2, P - 2, P)
+    for sign in (1, -1):
+        delta = (a0 + sign * alpha) * inv2 % P
+        x0 = pow(delta, (P + 1) // 4, P)
+        if x0 * x0 % P != delta:
+            continue
+        if x0 == 0:
+            continue
+        x1 = a1 * inv2 % P * pow(x0, P - 2, P) % P
+        cand = (x0, x1)
+        if fq2_is_zero(fq2_sub(fq2_sq(cand), a)):
+            return cand
+    return None
+
+
+# ---------------------------------------------------------------------------
+# multi-scalar helpers (EIP-2537 MSM)
+# ---------------------------------------------------------------------------
+
+
+def g1_msm(pairs: Sequence[Tuple[G1Point, int]]) -> G1Point:
+    acc: G1Point = None
+    for pt, k in pairs:
+        acc = g1_add(acc, g1_mul(pt, k % R))
+    return acc
+
+
+def g2_msm(pairs: Sequence[Tuple[G2Point, int]]) -> G2Point:
+    acc: G2Point = None
+    for pt, k in pairs:
+        acc = g2_add(acc, g2_mul(pt, k % R))
+    return acc

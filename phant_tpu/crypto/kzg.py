@@ -1,0 +1,114 @@
+"""EIP-4844 KZG point-evaluation verification (precompile 0x0A backend).
+
+Verifies that a KZG commitment C to a blob polynomial p satisfies
+p(z) == y, given a proof [q(tau)]_1 with q = (p - y)/(X - z):
+
+    e(C - [y]_1, [1]_2) == e(proof, [tau - z]_2)
+
+checked as a single product via bls12_381.pairing_check.
+
+Trusted setup: the only ceremony datum this equation needs is [tau]_2
+(the commitments themselves arrive from the network).  The mainnet
+ceremony bytes are public constants but are NOT embedded here (this tree
+is built in a zero-egress environment and a misremembered constant would
+be silent consensus divergence — worse than a loud gap).  Supply them via
+PHANT_KZG_SETUP_G2=<hex of the 96-byte compressed [tau]_2> or a chainspec
+"kzgSetupG2" field; without either, an explicitly INSECURE dev setup with
+a known tau serves tests and self-generated chains, and `setup_source()`
+says which one is active so callers/operators can refuse to validate
+mainnet with the dev setup.
+
+Reference scope anchor: src/blockchain/params.zig:30-39 (the precompile
+set the VM must serve; the reference predates 4844 and stops at 0x09).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+from typing import Optional, Tuple
+
+from phant_tpu.crypto import bls12_381 as bls
+
+BLS_MODULUS = bls.R
+FIELD_ELEMENTS_PER_BLOB = 4096
+VERSIONED_HASH_VERSION_KZG = 0x01
+
+# tau for the INSECURE dev setup — a fixed, public constant, so anyone can
+# forge proofs against it.  Never use for a chain whose blobs you did not
+# produce yourself.
+_DEV_TAU = (
+    int.from_bytes(hashlib.sha256(b"phant-tpu insecure dev kzg setup").digest(), "big")
+    % BLS_MODULUS
+)
+
+_SETUP: Optional[Tuple[bls.G2Point, str]] = None
+
+
+def dev_tau() -> int:
+    """The dev setup's tau (public by construction — tests use it to build
+    commitments/proofs by direct scalar arithmetic)."""
+    return _DEV_TAU
+
+
+def _load_setup() -> Tuple[bls.G2Point, str]:
+    hexstr = os.environ.get("PHANT_KZG_SETUP_G2", "")
+    if hexstr:
+        raw = bytes.fromhex(hexstr.removeprefix("0x"))
+        return bls.g2_decompress(raw), "operator"
+    return bls.g2_mul(bls.G2_GEN, _DEV_TAU), "insecure-dev"
+
+
+def setup_g2_tau() -> bls.G2Point:
+    global _SETUP
+    if _SETUP is None:
+        _SETUP = _load_setup()
+    return _SETUP[0]
+
+
+def setup_source() -> str:
+    """"operator" (real ceremony bytes supplied) or "insecure-dev"."""
+    global _SETUP
+    if _SETUP is None:
+        _SETUP = _load_setup()
+    return _SETUP[1]
+
+
+def reset_setup_cache() -> None:
+    global _SETUP
+    _SETUP = None
+
+
+def kzg_to_versioned_hash(commitment: bytes) -> bytes:
+    return bytes([VERSIONED_HASH_VERSION_KZG]) + hashlib.sha256(commitment).digest()[1:]
+
+
+class KZGProofError(ValueError):
+    pass
+
+
+def verify_kzg_proof(
+    commitment: bytes, z: bytes, y: bytes, proof: bytes
+) -> bool:
+    """The EIP-4844 verify_kzg_proof: True iff the proof checks out.
+
+    Raises KZGProofError for malformed inputs (non-canonical field
+    elements, invalid/off-subgroup points) — the precompile maps any
+    raise to a precompile failure.
+    """
+    z_int = int.from_bytes(z, "big")
+    y_int = int.from_bytes(y, "big")
+    if z_int >= BLS_MODULUS or y_int >= BLS_MODULUS:
+        raise KZGProofError("field element not canonical")
+    try:
+        c_pt = bls.g1_decompress(commitment)
+        proof_pt = bls.g1_decompress(proof)
+    except bls.PointDecodeError as e:
+        raise KZGProofError(str(e)) from e
+    # e(C - [y]_1, [1]_2) == e(proof, [tau]_2 - [z]_2)
+    # <=> e(C - [y]_1, [1]_2) * e(-proof, [tau - z]_2) == 1
+    p_minus_y = bls.g1_add(c_pt, bls.g1_mul(bls.G1_GEN, -y_int))
+    x_minus_z = bls.g2_add(setup_g2_tau(), bls.g2_mul(bls.G2_GEN, -z_int))
+    return bls.pairing_check(
+        [(p_minus_y, bls.G2_GEN), (bls.g1_neg(proof_pt), x_minus_z)]
+    )

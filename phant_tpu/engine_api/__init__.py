@@ -215,6 +215,9 @@ class ExecutionPayload:
     # V3 (Cancun): passed beside the payload in newPayloadV3, but part of
     # the header (and thus of blockHash)
     parent_beacon_block_root: Optional[bytes] = None
+    # V4 (Prague): derived from the executionRequests side channel, part
+    # of the header (and thus of blockHash)
+    requests_hash: Optional[bytes] = None
 
     def to_block(self) -> Block:
         """Build a Block, deriving tx/withdrawal MPT roots for the header
@@ -247,6 +250,7 @@ class ExecutionPayload:
             blob_gas_used=self.blob_gas_used,
             excess_blob_gas=self.excess_blob_gas,
             parent_beacon_block_root=self.parent_beacon_block_root,
+            requests_hash=self.requests_hash,
         )
         return Block(
             header=header,
@@ -337,6 +341,45 @@ def new_payload_v3_handler(
             validation_error="blob versioned hashes mismatch",
         )
     return new_payload_v2_handler(blockchain, payload)
+
+
+def new_payload_v4_handler(
+    blockchain,
+    payload: ExecutionPayload,
+    expected_blob_versioned_hashes,
+    parent_beacon_block_root: bytes,
+    execution_requests,
+) -> PayloadStatusV1:
+    """`engine_newPayloadV4` (Prague): validates the executionRequests
+    side channel per EIP-7685's engine rules (strictly type-ascending,
+    no empty request data), folds its hash into the header, then runs the
+    V3 path.  run_block independently recomputes the requests from
+    execution (deposit logs + 7002/7251 system calls) and rejects the
+    block on mismatch."""
+    from dataclasses import replace as drep
+
+    from phant_tpu.blockchain.requests import compute_requests_hash
+
+    items = []
+    prev_type = -1
+    for raw in execution_requests:
+        item = hex_to_bytes(raw)
+        if len(item) < 2:
+            return PayloadStatusV1(
+                status="INVALID",
+                validation_error="executionRequests item without data",
+            )
+        if item[0] <= prev_type:
+            return PayloadStatusV1(
+                status="INVALID",
+                validation_error="executionRequests not strictly type-ascending",
+            )
+        prev_type = item[0]
+        items.append(item)
+    payload = drep(payload, requests_hash=compute_requests_hash(items))
+    return new_payload_v3_handler(
+        blockchain, payload, expected_blob_versioned_hashes, parent_beacon_block_root
+    )
 
 
 def new_payload_v2_handler(blockchain, payload: ExecutionPayload) -> PayloadStatusV1:
@@ -538,7 +581,7 @@ SUPPORTED_METHODS = (
     "engine_newPayloadV1",
     "engine_newPayloadV2",  # * implemented
     "engine_newPayloadV3",  # * implemented (Cancun; beyond reference)
-    "engine_newPayloadV4",
+    "engine_newPayloadV4",  # * implemented (Prague; beyond reference)
     "engine_newPayloadWithWitnessV1",
     "engine_newPayloadWithWitnessV2",
     "engine_newPayloadWithWitnessV3",
@@ -586,6 +629,23 @@ def handle_request(blockchain, request: dict) -> Tuple[int, dict]:
             with metrics.phase("engine_api.new_payload"):
                 status = new_payload_v3_handler(
                     blockchain, payload, expected_hashes, beacon_root
+                )
+            return 200, {**base, "result": status.to_json()}
+        if method == "engine_newPayloadV4":
+            with metrics.phase("engine_api.decode_payload"):
+                payload = payload_from_json(request["params"][0])
+                expected_hashes = [
+                    hex_to_hash(h) for h in request["params"][1]
+                ]
+                beacon_root = hex_to_hash(request["params"][2])
+                execution_requests = request["params"][3]
+            with metrics.phase("engine_api.new_payload"):
+                status = new_payload_v4_handler(
+                    blockchain,
+                    payload,
+                    expected_hashes,
+                    beacon_root,
+                    execution_requests,
                 )
             return 200, {**base, "result": status.to_json()}
         if method == "engine_executeStatelessPayloadV1":

@@ -28,7 +28,7 @@ from phant_tpu.evm.message import (
     REVISION_CANCUN,
     REVISION_PRAGUE,
 )
-from phant_tpu.evm.precompiles import PRECOMPILES, precompile_addresses
+from phant_tpu.evm.precompiles import active_precompiles
 from phant_tpu.types.receipt import Log
 from phant_tpu import rlp
 
@@ -179,8 +179,9 @@ class Evm:
             state.sub_balance(msg.caller, msg.value)
             state.add_balance(target, msg.value)
 
-        if code_addr in PRECOMPILES:
-            result = PRECOMPILES[code_addr](msg.data, msg.gas)
+        precompiles = active_precompiles(self.env.revision)
+        if code_addr in precompiles:
+            result = precompiles[code_addr](msg.data, msg.gas)
             if not result.success:
                 state.revert_to(snapshot)
             return result

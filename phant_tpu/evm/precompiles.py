@@ -1,9 +1,13 @@
-"""Precompiled contracts at addresses 0x01..0x09 (Shanghai set).
+"""Precompiled contracts: 0x01..0x09 (Shanghai), +0x0A (Cancun),
++0x0B..0x11 (Prague, in precompiles_bls.py).
 
-The reference only lists the nine addresses for EIP-2929 warm-set prefill
-(reference: src/blockchain/params.zig:19-29) and relies on evmone for
-behavior; here each is implemented natively in Python (bn254 pairing in
-phant_tpu/crypto/bn254.py).
+The reference only lists the nine Shanghai addresses for EIP-2929
+warm-set prefill (reference: src/blockchain/params.zig:19-29) and relies
+on evmone for behavior; here each is implemented natively in Python
+(bn254 pairing in phant_tpu/crypto/bn254.py, BLS12-381/KZG in
+phant_tpu/crypto/bls12_381.py + kzg.py).  Both EVM backends dispatch
+through this module (the C++ core's host split leaves precompiles to the
+host, native/evm.cc:1378-1381).
 """
 
 from __future__ import annotations
@@ -12,15 +16,22 @@ import hashlib
 from typing import Callable, Dict, List
 
 from phant_tpu.crypto import secp256k1
-from phant_tpu.evm.message import ExecResult
+from phant_tpu.evm.message import REVISION_CANCUN, REVISION_PRAGUE, ExecResult
 
 
 def _addr(n: int) -> bytes:
     return n.to_bytes(20, "big")
 
 
-def precompile_addresses() -> List[bytes]:
-    return [_addr(i) for i in range(1, 10)]
+def precompile_addresses(revision: int = 0) -> List[bytes]:
+    """Active precompile addresses for the revision (EIP-2929 prefill and
+    dispatch share this one definition so they cannot diverge)."""
+    hi = 9
+    if revision >= REVISION_CANCUN:
+        hi = 10
+    if revision >= REVISION_PRAGUE:
+        hi = 17
+    return [_addr(i) for i in range(1, hi + 1)]
 
 
 def _words(n: int) -> int:
@@ -247,3 +258,30 @@ PRECOMPILES: Dict[bytes, Callable[[bytes, int], ExecResult]] = {
     _addr(8): _bn_pairing,
     _addr(9): _blake2f,
 }
+
+
+import functools
+
+
+@functools.lru_cache(maxsize=None)
+def active_precompiles(
+    revision: int,
+) -> Dict[bytes, Callable[[bytes, int], ExecResult]]:
+    """Dispatch table for the revision, memoized (this is looked up per
+    message frame in the EVM hot path).  Calling a future fork's address
+    under an older revision is an ordinary (empty-account) call."""
+    if revision < REVISION_CANCUN:
+        return PRECOMPILES
+    from phant_tpu.evm import precompiles_bls as pb
+
+    table = dict(PRECOMPILES)
+    table[_addr(0x0A)] = pb.point_evaluation
+    if revision >= REVISION_PRAGUE:
+        table[_addr(0x0B)] = pb.bls_g1_add
+        table[_addr(0x0C)] = pb.bls_g1_msm
+        table[_addr(0x0D)] = pb.bls_g2_add
+        table[_addr(0x0E)] = pb.bls_g2_msm
+        table[_addr(0x0F)] = pb.bls_pairing
+        table[_addr(0x10)] = pb.bls_map_fp_to_g1
+        table[_addr(0x11)] = pb.bls_map_fp2_to_g2
+    return table

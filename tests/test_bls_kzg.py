@@ -1,0 +1,268 @@
+"""BLS12-381, KZG point evaluation (0x0A), and EIP-2537 precompile tests.
+
+The KZG tests exploit the dev setup's public tau: commitments and proofs
+are built by DIRECT SCALAR ARITHMETIC (p(tau) etc. computed mod r, then
+one G1 scalar mul), while the precompile verifies them via PAIRINGS —
+two independent evaluation paths that agree only if the pairing, the
+group law, and the serialization all match.
+"""
+
+import pytest
+
+from phant_tpu.crypto import bls12_381 as bls
+from phant_tpu.crypto import kzg
+from phant_tpu.evm import precompiles_bls as pb
+from phant_tpu.evm.message import (
+    REVISION_CANCUN,
+    REVISION_PRAGUE,
+    REVISION_SHANGHAI,
+)
+from phant_tpu.evm.precompiles import active_precompiles, precompile_addresses
+
+
+def _addr(n: int) -> bytes:
+    return n.to_bytes(20, "big")
+
+
+# ---------------------------------------------------------------------------
+# curve / pairing core
+# ---------------------------------------------------------------------------
+
+
+def test_generators_valid():
+    assert bls.g1_is_on_curve(bls.G1_GEN)
+    assert bls.g2_is_on_curve(bls.G2_GEN)
+    assert bls.g1_in_subgroup(bls.G1_GEN)
+    assert bls.g2_in_subgroup(bls.G2_GEN)
+
+
+def test_group_law_consistency():
+    # (a+b)G == aG + bG, and order-r annihilation
+    a, b = 1234567, 7654321
+    assert bls.g1_add(bls.g1_mul(bls.G1_GEN, a), bls.g1_mul(bls.G1_GEN, b)) == bls.g1_mul(
+        bls.G1_GEN, a + b
+    )
+    assert bls.g1_mul(bls.G1_GEN, bls.R) is None
+    lhs = bls.g2_add(bls.g2_mul(bls.G2_GEN, a), bls.g2_mul(bls.G2_GEN, b))
+    assert lhs == bls.g2_mul(bls.G2_GEN, a + b)
+    assert bls.g2_mul(bls.G2_GEN, bls.R) is None
+
+
+def test_pairing_bilinearity():
+    a, b = 7, 11
+    assert bls.pairing_check(
+        [
+            (bls.g1_mul(bls.G1_GEN, a), bls.g2_mul(bls.G2_GEN, b)),
+            (bls.g1_mul(bls.G1_GEN, -a * b), bls.G2_GEN),
+        ]
+    )
+    # non-degenerate
+    assert not bls.pairing_check([(bls.G1_GEN, bls.G2_GEN)])
+
+
+def test_compression_roundtrip():
+    pt = bls.g1_mul(bls.G1_GEN, 987654321)
+    assert bls.g1_decompress(bls.g1_compress(pt)) == pt
+    qt = bls.g2_mul(bls.G2_GEN, 123456789)
+    assert bls.g2_decompress(bls.g2_compress(qt)) == qt
+    # infinity
+    assert bls.g1_decompress(bls.g1_compress(None)) is None
+    # the negated point decodes to itself, not its twin
+    npt = bls.g1_neg(pt)
+    assert bls.g1_decompress(bls.g1_compress(npt)) == npt
+
+
+def test_decompress_rejects_bad_points():
+    with pytest.raises(bls.PointDecodeError):
+        bls.g1_decompress(b"\x00" * 48)  # compression bit unset
+    with pytest.raises(bls.PointDecodeError):
+        bls.g1_decompress(b"\x80" + b"\x00" * 47)  # x=0 not on curve
+    # canonical-range check: x = p
+    bad = bytearray(bls.P.to_bytes(48, "big"))
+    bad[0] |= 0x80
+    with pytest.raises(bls.PointDecodeError):
+        bls.g1_decompress(bytes(bad))
+
+
+# ---------------------------------------------------------------------------
+# KZG point evaluation (0x0A)
+# ---------------------------------------------------------------------------
+
+
+def _kzg_fixture(z: int, poly=(5, 3, 2)):
+    """Commit to p(X) = sum poly[i] X^i with the dev tau; return the 192-byte
+    precompile input proving p(z)."""
+    tau = kzg.dev_tau()
+    r = bls.R
+    p_tau = sum(c * pow(tau, i, r) for i, c in enumerate(poly)) % r
+    y = sum(c * pow(z, i, r) for i, c in enumerate(poly)) % r
+    # q = (p - y)/(X - z) evaluated at tau via modular inverse
+    q_tau = (p_tau - y) * pow((tau - z) % r, r - 2, r) % r
+    commitment = bls.g1_compress(bls.g1_mul(bls.G1_GEN, p_tau))
+    proof = bls.g1_compress(bls.g1_mul(bls.G1_GEN, q_tau))
+    vh = kzg.kzg_to_versioned_hash(commitment)
+    return (
+        vh + z.to_bytes(32, "big") + y.to_bytes(32, "big") + commitment + proof,
+        y,
+    )
+
+
+def test_point_evaluation_accepts_valid_proof():
+    data, _y = _kzg_fixture(z=31337)
+    out = pb.point_evaluation(data, 60_000)
+    assert out.success, out.error
+    assert out.gas_left == 10_000
+    assert out.output == (4096).to_bytes(32, "big") + bls.R.to_bytes(32, "big")
+
+
+def test_point_evaluation_rejects_wrong_y():
+    data, y = _kzg_fixture(z=42)
+    tampered = data[:64] + ((y + 1) % bls.R).to_bytes(32, "big") + data[96:]
+    out = pb.point_evaluation(tampered, 60_000)
+    assert not out.success
+
+
+def test_point_evaluation_rejects_versioned_hash_mismatch():
+    data, _ = _kzg_fixture(z=7)
+    bad = bytes([0x02]) + data[1:]
+    out = pb.point_evaluation(bad, 60_000)
+    assert not out.success
+
+
+def test_point_evaluation_rejects_malformed():
+    data, _ = _kzg_fixture(z=7)
+    assert not pb.point_evaluation(data[:-1], 60_000).success  # length
+    # z >= BLS_MODULUS
+    bad = data[:32] + bls.R.to_bytes(32, "big") + data[64:]
+    bad = kzg.kzg_to_versioned_hash(bad[96:144])[:32] + bad[32:]
+    assert not pb.point_evaluation(bad, 60_000).success
+    assert not pb.point_evaluation(data, 49_999).success  # OOG
+
+
+def test_kzg_setup_source_is_dev_without_operator_bytes(monkeypatch):
+    monkeypatch.delenv("PHANT_KZG_SETUP_G2", raising=False)
+    kzg.reset_setup_cache()
+    assert kzg.setup_source() == "insecure-dev"
+    # operator-supplied bytes are honored (round-trip through compression)
+    g2tau = bls.g2_compress(bls.g2_mul(bls.G2_GEN, kzg.dev_tau()))
+    monkeypatch.setenv("PHANT_KZG_SETUP_G2", g2tau.hex())
+    kzg.reset_setup_cache()
+    assert kzg.setup_source() == "operator"
+    data, _ = _kzg_fixture(z=99)
+    assert pb.point_evaluation(data, 60_000).success
+    kzg.reset_setup_cache()
+
+
+# ---------------------------------------------------------------------------
+# EIP-2537
+# ---------------------------------------------------------------------------
+
+
+def _enc_g1(pt):
+    return pb._write_g1(pt)
+
+
+def _enc_g2(pt):
+    return pb._write_g2(pt)
+
+
+def test_bls_g1_add():
+    g = bls.G1_GEN
+    g2 = bls.g1_mul(g, 2)
+    out = pb.bls_g1_add(_enc_g1(g) + _enc_g1(g2), 10_000)
+    assert out.success
+    assert out.output == _enc_g1(bls.g1_mul(g, 3))
+    assert out.gas_left == 10_000 - pb.G1ADD_GAS
+    # identity
+    out = pb.bls_g1_add(_enc_g1(None) + _enc_g1(g), 10_000)
+    assert out.success and out.output == _enc_g1(g)
+    # not on curve -> error
+    bad = pb._write_fp(1) + pb._write_fp(1)
+    assert not pb.bls_g1_add(bad + _enc_g1(g), 10_000).success
+
+
+def test_bls_g2_add():
+    q = bls.G2_GEN
+    out = pb.bls_g2_add(_enc_g2(q) + _enc_g2(q), 10_000)
+    assert out.success
+    assert out.output == _enc_g2(bls.g2_mul(q, 2))
+
+
+def test_bls_g1_msm():
+    g = bls.G1_GEN
+    pairs = _enc_g1(g) + (2).to_bytes(32, "big")
+    pairs += _enc_g1(bls.g1_mul(g, 2)) + (3).to_bytes(32, "big")
+    out = pb.bls_g1_msm(pairs, 100_000)
+    assert out.success
+    assert out.output == _enc_g1(bls.g1_mul(g, 8))
+    assert out.gas_left == 100_000 - pb.msm_gas(2, g2=False)
+    # k=1 MSM costs exactly the MUL price (discount 1000)
+    assert pb.msm_gas(1, g2=False) == pb.G1MUL_GAS
+    assert pb.msm_gas(1, g2=True) == pb.G2MUL_GAS
+
+
+def test_bls_g2_msm():
+    q = bls.G2_GEN
+    pairs = _enc_g2(q) + (5).to_bytes(32, "big")
+    out = pb.bls_g2_msm(pairs, 100_000)
+    assert out.success
+    assert out.output == _enc_g2(bls.g2_mul(q, 5))
+
+
+def test_bls_pairing_precompile():
+    a, b = 3, 5
+    good = (
+        _enc_g1(bls.g1_mul(bls.G1_GEN, a))
+        + _enc_g2(bls.g2_mul(bls.G2_GEN, b))
+        + _enc_g1(bls.g1_mul(bls.G1_GEN, -a * b % bls.R))
+        + _enc_g2(bls.G2_GEN)
+    )
+    out = pb.bls_pairing(good, 200_000)
+    assert out.success
+    assert out.output == (1).to_bytes(32, "big")
+    bad = good[:384] + _enc_g1(bls.G1_GEN) + _enc_g2(bls.G2_GEN)
+    out = pb.bls_pairing(bad, 200_000)
+    assert out.success
+    assert out.output == (0).to_bytes(32, "big")
+
+
+def test_bls_pairing_rejects_non_subgroup_g2():
+    # a point on E'(Fq2) but outside the r-torsion: find one by hashing x
+    # candidates until y exists, then check it's NOT in the subgroup
+    x0 = 1
+    while True:
+        x = (x0, 0)
+        y2 = bls.fq2_add(bls.fq2_mul(bls.fq2_sq(x), x), bls.B2)
+        y = bls.fq2_sqrt(y2)
+        if y is not None and not bls.g2_in_subgroup((x, y)):
+            rogue = (x, y)
+            break
+        x0 += 1
+    data = _enc_g1(bls.G1_GEN) + _enc_g2(rogue)
+    assert not pb.bls_pairing(data, 200_000).success
+
+
+def test_map_precompiles_are_gated():
+    with pytest.raises(pb.ConsensusDataUnavailable):
+        pb.bls_map_fp_to_g1(pb._write_fp(123), 10_000)
+    with pytest.raises(pb.ConsensusDataUnavailable):
+        pb.bls_map_fp2_to_g2(pb._write_fp(1) + pb._write_fp(2), 30_000)
+    # malformed input fails BEFORE the gate (ordinary precompile error)
+    assert not pb.bls_map_fp_to_g1(b"\x01" * 64, 10_000).success
+
+
+# ---------------------------------------------------------------------------
+# revision gating
+# ---------------------------------------------------------------------------
+
+
+def test_precompile_revision_gating():
+    shanghai = active_precompiles(REVISION_SHANGHAI)
+    cancun = active_precompiles(REVISION_CANCUN)
+    prague = active_precompiles(REVISION_PRAGUE)
+    assert _addr(0x0A) not in shanghai
+    assert _addr(0x0A) in cancun and _addr(0x0B) not in cancun
+    assert all(_addr(i) in prague for i in range(1, 0x12))
+    assert precompile_addresses(REVISION_SHANGHAI) == [_addr(i) for i in range(1, 10)]
+    assert precompile_addresses(REVISION_CANCUN)[-1] == _addr(0x0A)
+    assert precompile_addresses(REVISION_PRAGUE)[-1] == _addr(0x11)

@@ -65,9 +65,16 @@ def _set_code_tx(auths, to=None, nonce=0, data=b"", value=0, gas=400_000):
 
 
 def _genesis(extra_accounts=None):
+    from phant_tpu.blockchain import requests as req
+
     accounts = {
         SENDER: Account(balance=10**24),
         DELEGATE: Account(code=DELEGATE_CODE),
+        # EIP-7002/7251 predeploys (a Prague block without them is
+        # invalid); mock runtime returns an empty request queue:
+        # PUSH0 PUSH0 RETURN
+        req.WITHDRAWAL_REQUEST_ADDRESS: Account(nonce=1, code=bytes.fromhex("5f5ff3")),
+        req.CONSOLIDATION_REQUEST_ADDRESS: Account(nonce=1, code=bytes.fromhex("5f5ff3")),
     }
     accounts.update(extra_accounts or {})
     header = BlockHeader(
@@ -104,6 +111,7 @@ def _block_with(txs, genesis, chain):
         gas_used=result.gas_used,
         receipts_root=ordered_trie_root([r.encode() for r in result.receipts]),
         logs_bloom=result.logs_bloom,
+        requests_hash=result.requests_hash,
     )
     return Block(header=header, transactions=tuple(txs), withdrawals=()), result
 

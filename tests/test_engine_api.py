@@ -212,12 +212,11 @@ def test_fork_for_config():
     assert isinstance(fork_for(cfg, state, 0, 0), FrontierFork)
     assert isinstance(fork_for(cfg, state, 0, cfg.shanghaiTime), FrontierFork)
     assert isinstance(fork_for(cfg, state, 0, cfg.cancunTime), CancunFork)
-    # the shipped chainspec only advertises executable forks (no
-    # pragueTime until type-4 txs land); a custom spec still dispatches
-    cfg2 = ChainConfig.from_chain_id(ChainId.Mainnet)
-    cfg2.pragueTime = cfg.cancunTime + 1
-    assert isinstance(fork_for(cfg2, state, 0, cfg2.pragueTime), PragueFork)
-    assert cfg.pragueTime is None
+    # Prague is advertised since r5 (7702/7623/2935/2537/7685 executable);
+    # pre-Prague Cancun timestamps still dispatch CancunFork
+    assert cfg.pragueTime is not None
+    assert isinstance(fork_for(cfg, state, 0, cfg.pragueTime - 1), CancunFork)
+    assert isinstance(fork_for(cfg, state, 0, cfg.pragueTime), PragueFork)
 
 
 def test_crypto_backend_dispatch():

@@ -1,0 +1,242 @@
+"""EIP-7685/6110/7002/7251 execution-layer requests tests (Prague).
+
+Uses the test_eip7702 synthetic-chain helpers' pattern: a PragueFork
+chain whose pre-state carries mock predeploys.  The mock 7002/7251
+contracts return fixed request bytes pushed via MSTORE; the deposit
+contract emits a spec-shaped DepositEvent log.
+"""
+
+from dataclasses import replace as drep
+
+import hashlib
+
+import pytest
+
+from phant_tpu.blockchain import requests as req
+from phant_tpu.blockchain.chain import BlockError, Blockchain, calculate_base_fee
+from phant_tpu.blockchain.fork import PragueFork
+from phant_tpu.crypto import secp256k1 as secp
+from phant_tpu.mpt.mpt import EMPTY_TRIE_ROOT, ordered_trie_root
+from phant_tpu.signer.signer import TxSigner, address_from_pubkey
+from phant_tpu.state.statedb import StateDB
+from phant_tpu.types.account import Account
+from phant_tpu.types.block import Block, BlockHeader
+from phant_tpu.types.receipt import logs_bloom
+from phant_tpu.types.transaction import FeeMarketTx
+
+CHAIN_ID = 1
+SENDER_KEY = 0xCC1
+SENDER = address_from_pubkey(secp.pubkey_of(SENDER_KEY))
+
+
+def _return_const_code(data: bytes) -> bytes:
+    """Runtime bytecode: RETURN(data) for len(data) <= 32."""
+    assert 0 < len(data) <= 32
+    # PUSH<len> data; PUSH1 0; MSTORE — left-aligns via shift: simpler to
+    # store right-aligned then return the tail window of the 32-byte word
+    push = bytes([0x5F + len(data)]) + data  # PUSHn data
+    code = push + bytes.fromhex("600052")  # MSTORE at 0 (right-aligned)
+    off = 32 - len(data)
+    code += bytes([0x60, len(data), 0x60, off, 0xF3])  # RETURN(off, len)
+    return code
+
+
+def _deposit_event_data(pubkey: bytes, wc: bytes, amount: bytes, sig: bytes, index: bytes) -> bytes:
+    def word(n: int) -> bytes:
+        return n.to_bytes(32, "big")
+
+    def tail(payload: bytes) -> bytes:
+        padded = payload + bytes(-len(payload) % 32)
+        return word(len(payload)) + padded
+
+    return (
+        word(160) + word(256) + word(320) + word(384) + word(512)
+        + tail(pubkey) + tail(wc) + tail(amount) + tail(sig) + tail(index)
+    )
+
+
+VALID_EVENT = _deposit_event_data(
+    b"\x01" * 48, b"\x02" * 32, b"\x03" * 8, b"\x04" * 96, b"\x05" * 8
+)
+VALID_REQUEST = b"\x01" * 48 + b"\x02" * 32 + b"\x03" * 8 + b"\x04" * 96 + b"\x05" * 8
+
+
+# ---------------------------------------------------------------------------
+# unit: deposit event parsing + requests hash
+# ---------------------------------------------------------------------------
+
+
+def test_parse_deposit_event():
+    assert req.parse_deposit_event_data(VALID_EVENT) == VALID_REQUEST
+
+
+def test_parse_deposit_event_rejects_malformed():
+    with pytest.raises(req.RequestsError):
+        req.parse_deposit_event_data(VALID_EVENT[:-32])  # wrong length
+    bad = (300).to_bytes(32, "big") + VALID_EVENT[32:]  # wrong offset
+    with pytest.raises(req.RequestsError):
+        req.parse_deposit_event_data(bad)
+    bad = VALID_EVENT[:160] + (49).to_bytes(32, "big") + VALID_EVENT[192:]
+    with pytest.raises(req.RequestsError):
+        req.parse_deposit_event_data(bad)
+
+
+def test_requests_hash_shape():
+    # empty list -> sha256 of nothing
+    assert req.compute_requests_hash([]) == hashlib.sha256(b"").digest()
+    items = [b"\x00" + VALID_REQUEST, b"\x01" + b"\xaa" * 76]
+    expect = hashlib.sha256(
+        hashlib.sha256(items[0]).digest() + hashlib.sha256(items[1]).digest()
+    ).digest()
+    assert req.compute_requests_hash(items) == expect
+
+
+# ---------------------------------------------------------------------------
+# end-to-end: Prague block with deposits + dequeued requests
+# ---------------------------------------------------------------------------
+
+WITHDRAWAL_BYTES = b"\xaa" * 20  # mock queue contents (opaque to the EL)
+CONSOLIDATION_BYTES = b"\xbb" * 24
+
+def _deposit_logger_code() -> bytes:
+    """Mock deposit contract: re-emits its calldata as a DepositEvent log.
+    CALLDATACOPY(0, 0, 576); LOG1(0, 576, topic); STOP."""
+    return (
+        # PUSH2 0x0240; PUSH1 0; PUSH1 0; CALLDATACOPY
+        bytes.fromhex("6102406000600037")
+        + b"\x7f" + req.DEPOSIT_EVENT_SIGNATURE_HASH  # PUSH32 topic
+        # PUSH2 0x0240 (size); PUSH1 0 (offset); LOG1; STOP
+        + bytes.fromhex("6102406000a100")
+    )
+
+
+def _accounts():
+    return {
+        SENDER: Account(balance=10**24),
+        req.DEPOSIT_CONTRACT_ADDRESS: Account(nonce=1, code=_deposit_logger_code()),
+        req.WITHDRAWAL_REQUEST_ADDRESS: Account(
+            nonce=1, code=_return_const_code(WITHDRAWAL_BYTES)
+        ),
+        req.CONSOLIDATION_REQUEST_ADDRESS: Account(
+            nonce=1, code=_return_const_code(CONSOLIDATION_BYTES)
+        ),
+    }
+
+
+def _genesis_header():
+    return BlockHeader(
+        block_number=0, gas_limit=30_000_000, gas_used=0,
+        timestamp=1_800_000_000, base_fee_per_gas=10**9,
+        withdrawals_root=EMPTY_TRIE_ROOT, blob_gas_used=0, excess_blob_gas=0,
+    )
+
+
+def _deposit_tx(nonce=0):
+    signer = TxSigner(CHAIN_ID)
+    return signer.sign(
+        FeeMarketTx(
+            chain_id_val=CHAIN_ID, nonce=nonce, max_priority_fee_per_gas=1,
+            max_fee_per_gas=10**10, gas_limit=400_000,
+            to=req.DEPOSIT_CONTRACT_ADDRESS, value=0, data=VALID_EVENT,
+            access_list=(), y_parity=0, r=0, s=0,
+        ),
+        SENDER_KEY,
+    )
+
+
+def _build_and_run(txs, accounts, requests_hash_override=None):
+    genesis = _genesis_header()
+    build_state = StateDB({a: acct.copy() for a, acct in accounts.items()})
+    build_chain = Blockchain(
+        CHAIN_ID, build_state, genesis,
+        fork=PragueFork(build_state), verify_state_root=False,
+    )
+    base_fee = calculate_base_fee(
+        genesis.gas_limit, genesis.gas_used, genesis.base_fee_per_gas
+    )
+    draft = BlockHeader(
+        parent_hash=genesis.hash(), block_number=1,
+        gas_limit=30_000_000, gas_used=0, timestamp=genesis.timestamp + 12,
+        base_fee_per_gas=base_fee,
+        transactions_root=ordered_trie_root([t.encode() for t in txs]),
+        receipts_root=EMPTY_TRIE_ROOT, withdrawals_root=EMPTY_TRIE_ROOT,
+        logs_bloom=logs_bloom([]), blob_gas_used=0, excess_blob_gas=0,
+        parent_beacon_block_root=b"\x5b" * 32,
+    )
+    result = build_chain.apply_body(
+        Block(header=draft, transactions=tuple(txs), withdrawals=())
+    )
+    header = drep(
+        draft,
+        gas_used=result.gas_used,
+        receipts_root=ordered_trie_root([r.encode() for r in result.receipts]),
+        logs_bloom=result.logs_bloom,
+        requests_hash=(
+            requests_hash_override
+            if requests_hash_override is not None
+            else result.requests_hash
+        ),
+    )
+    block = Block(header=header, transactions=tuple(txs), withdrawals=())
+
+    state = StateDB({a: acct.copy() for a, acct in accounts.items()})
+    chain = Blockchain(
+        CHAIN_ID, state, genesis,
+        fork=PragueFork(state), verify_state_root=False,
+    )
+    chain.run_block(block)
+    return result
+
+
+def test_block_requests_hash_end_to_end():
+    result = _build_and_run([_deposit_tx()], _accounts())
+    expect = req.compute_requests_hash(
+        [
+            req.DEPOSIT_REQUEST_TYPE + VALID_REQUEST,
+            req.WITHDRAWAL_REQUEST_TYPE + WITHDRAWAL_BYTES,
+            req.CONSOLIDATION_REQUEST_TYPE + CONSOLIDATION_BYTES,
+        ]
+    )
+    assert result.requests_hash == expect
+
+
+def test_block_rejects_wrong_requests_hash():
+    with pytest.raises(BlockError, match="requests hash mismatch"):
+        _build_and_run([_deposit_tx()], _accounts(), requests_hash_override=b"\x00" * 32)
+
+
+def test_block_rejects_missing_predeploy():
+    accounts = _accounts()
+    del accounts[req.WITHDRAWAL_REQUEST_ADDRESS]
+    with pytest.raises(BlockError, match="missing system contract"):
+        _build_and_run([], accounts)
+
+
+def test_empty_queues_and_no_deposits():
+    accounts = _accounts()
+    accounts[req.WITHDRAWAL_REQUEST_ADDRESS] = Account(
+        nonce=1, code=bytes.fromhex("5f5ff3")
+    )
+    accounts[req.CONSOLIDATION_REQUEST_ADDRESS] = Account(
+        nonce=1, code=bytes.fromhex("5f5ff3")
+    )
+    result = _build_and_run([], accounts)
+    assert result.requests_hash == hashlib.sha256(b"").digest()
+
+
+def test_malformed_deposit_event_invalidates_block():
+    accounts = _accounts()
+    signer = TxSigner(CHAIN_ID)
+    bad_tx = signer.sign(
+        FeeMarketTx(
+            chain_id_val=CHAIN_ID, nonce=0, max_priority_fee_per_gas=1,
+            max_fee_per_gas=10**10, gas_limit=400_000,
+            to=req.DEPOSIT_CONTRACT_ADDRESS, value=0,
+            # corrupt the pubkey offset word (160 -> 161): layout violation
+            data=(161).to_bytes(32, "big") + VALID_EVENT[32:],
+            access_list=(), y_parity=0, r=0, s=0,
+        ),
+        SENDER_KEY,
+    )
+    with pytest.raises(BlockError, match="deposit event"):
+        _build_and_run([bad_tx], accounts)
