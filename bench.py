@@ -490,8 +490,55 @@ def _log(msg: str) -> None:
     print(f"[bench] {msg}", file=sys.stderr, flush=True)
 
 
+def _chip_efficiency(detail: dict) -> dict:
+    """detail.efficiency (VERDICT r4 #9): per-kernel achieved rate against
+    an explicit chip roofline, plus the device-seconds already captured,
+    so "is the kernel fast or just correct" is answerable from the
+    artifact alone.
+
+    Roofline constants (v5e-1, public spec sheet): HBM bandwidth 819 GB/s
+    (the keccak kernel reads each payload byte exactly once from HBM, so
+    input bytes/s / 819e9 bounds any keccak kernel); the ALU bound is not
+    quoted because the measured kernel is far from both and the HBM bound
+    is the tighter audit anchor at these arithmetic intensities."""
+    HBM_BPS = 819e9
+    out: dict = {}
+    mbps = detail.get("keccak_pallas_resident_mbps")
+    if mbps:
+        out["keccak"] = {
+            "achieved_input_mbps": mbps,
+            "hbm_roofline_mbps": HBM_BPS / 1e6,
+            "fraction_of_hbm_roofline": round(mbps * 1e6 / HBM_BPS, 4),
+            "device_seconds": detail.get("keccak_device_seconds"),
+        }
+    rate = detail.get("ecrecover_per_sec")
+    if rate:
+        # ~2.3M u32 lane-ops per recovery: 256 ladder steps x ~9k ops
+        # (double + mixed add + exceptional double on 16x16-bit limbs)
+        out["ecrecover"] = {
+            "achieved_per_sec": rate,
+            "u32_ops_per_sec_est": round(rate * 2.3e6),
+            "device_seconds": detail.get("ecrecover_device_seconds"),
+        }
+    etpu = detail.get("engine_tpu_blocks_per_sec")
+    if etpu:
+        out["witness_engine"] = {
+            "achieved_blocks_per_sec": etpu,
+            "cached_linkage_ceiling_blocks_per_sec": detail.get(
+                "engine_cached_ceiling_blocks_per_sec"
+            ),
+            "device_seconds": detail.get("engine_device_seconds"),
+            "note": "steady state is host-routed unless the measured link "
+            "beats native hashing (see routing + tunnel_* keys)",
+        }
+    return out
+
+
 def _emit_final() -> None:
     detail = _PARTIAL.get("detail", {})
+    eff = _chip_efficiency(detail)
+    if eff:
+        detail["efficiency"] = eff
     print(
         json.dumps(
             {
@@ -1155,7 +1202,60 @@ def sec_ecrecover_device() -> dict:
             out[f"ecrecover_{kern}_error"] = repr(e)[:160]
     if best is not None:
         out["ecrecover_per_sec"] = round(best, 1)
+
+    # slope-timed RESIDENT rate for the production (Shamir) kernel: the
+    # per-call rates above include one tunnel round trip per invocation
+    # (~30-70ms on the dev link, a 15-40% haircut at this batch size);
+    # chaining k data-dependent invocations in one dispatch isolates the
+    # ladder itself (same methodology as _slope_time_chunked)
+    if os.environ.get("PHANT_BENCH_DEVICE", "0") == "1":
+        try:
+            out.update(_ecrecover_slope(msgs, rs, ss, recids, B))
+        except Exception as e:
+            out["ecrecover_slope_error"] = repr(e)[:160]
     return out
+
+
+def _ecrecover_slope(msgs, rs, ss, recids, B: int) -> dict:
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+
+    from phant_tpu.ops.secp256k1_jax import ecrecover_kernel, ints_to_limbs
+
+    os.environ["PHANT_ECRECOVER_KERNEL"] = "shamir"
+    e0 = jnp.asarray(ints_to_limbs([int.from_bytes(m, "big") for m in msgs]))
+    r0 = jnp.asarray(ints_to_limbs(rs))
+    s0 = jnp.asarray(ints_to_limbs(ss))
+    par = jnp.asarray(np.array([rid & 1 for rid in recids], np.uint32))
+
+    @functools.partial(jax.jit, static_argnames=("k",))
+    def chain(e, r, s, p, k):
+        def body(_, carry):
+            e_c, acc = carry
+            digest, _valid = ecrecover_kernel(e_c, r, s, p)
+            # fold the digest back into the message limbs (mask to the
+            # 16-bit limb domain) — data dependency without changing cost
+            e_c = e_c.at[:, :8].set(e_c[:, :8] ^ (digest & 0xFFFF))
+            return (e_c, acc ^ digest)
+
+        _, acc = jax.lax.fori_loop(
+            0, k, body, (e, jnp.zeros((B, 8), jnp.uint32))
+        )
+        return acc[:1, :1]
+
+    times = {}
+    for k in (1, 9):
+        np.asarray(chain(e0, r0, s0, par, k))  # compile + warm
+        best = float("inf")
+        for _ in range(3):
+            t0 = time.perf_counter()
+            np.asarray(chain(e0, r0, s0, par, k))
+            best = min(best, time.perf_counter() - t0)
+        times[k] = best
+    per = max((times[9] - times[1]) / 8, 1e-9)
+    return {"ecrecover_shamir_resident_per_sec": round(B / per, 1)}
 
 
 def _replay(backend: str, verify_root: bool) -> dict:

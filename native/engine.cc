@@ -249,7 +249,9 @@ struct NodeEntry {
 struct DigestEntry {
   uint64_t hash;
   int32_t refid;  // -1 = empty slot
-  uint32_t pad;
+  uint32_t pre4;  // first 4 digest bytes: probe filter (exact memcmp still
+                  // decides equality — this only prunes false slot hits
+                  // and lets commit's pass A run compare-free)
 };
 
 struct Engine {
@@ -276,6 +278,11 @@ struct Engine {
   std::vector<const uint8_t*> novel_ptrs;  // commit_hash scratch
   std::vector<uint32_t> novel_lens;
   std::vector<uint8_t> digest_scratch;
+  // commit's flattened digest-ref stream (pass A/B pipeline scratch)
+  std::vector<const uint8_t*> flat_d;
+  std::vector<uint64_t> flat_h;
+  std::vector<int32_t*> flat_out;
+  std::vector<int32_t> flat_refid;
 
   Engine() {
     seed = mix(reinterpret_cast<uint64_t>(this) ^ 0xa0761d6478bd642fULL,
@@ -359,11 +366,13 @@ struct Engine {
   int32_t find_refid(const uint8_t* d) const {
     const uint64_t h = hash_digest(d, seed);
     const uint64_t mask = dtab.size() - 1;
+    uint32_t p4;
+    std::memcpy(&p4, d, 4);
     uint64_t i = h & mask;
     while (true) {
       const DigestEntry& e = dtab[i];
       if (e.refid < 0) return -1;
-      if (e.hash == h &&
+      if (e.hash == h && e.pre4 == p4 &&
           std::memcmp(digest_arena.data() + 32 * e.refid, d, 32) == 0)
         return e.refid;
       i = (i + 1) & mask;
@@ -377,16 +386,19 @@ struct Engine {
   int32_t intern_digest_h(const uint8_t* d, uint64_t h) {
     if ((n_digests + 1) * 10 >= dtab.size() * 7) grow_dtab();
     const uint64_t mask = dtab.size() - 1;
+    uint32_t p4;
+    std::memcpy(&p4, d, 4);
     uint64_t i = h & mask;
     while (true) {
       DigestEntry& e = dtab[i];
       if (e.refid < 0) {
         e.hash = h;
         e.refid = static_cast<int32_t>(n_digests++);
+        e.pre4 = p4;
         digest_arena.insert(digest_arena.end(), d, d + 32);
         return e.refid;
       }
-      if (e.hash == h &&
+      if (e.hash == h && e.pre4 == p4 &&
           std::memcmp(digest_arena.data() + 32 * e.refid, d, 32) == 0)
         return e.refid;
       i = (i + 1) & mask;
@@ -479,28 +491,75 @@ int64_t phant_engine_commit_ptrs(void* h, const uint8_t* const* ptrs,
   const int64_t base_row = static_cast<int64_t>(E.own_refid.size());
   E.own_refid.resize(base_row + n_novel);
   E.child_refids.resize((base_row + n_novel) * kChildSlots, -1);
+
+  // The ~17 digest interns per novel node are random-access bound; a
+  // per-node prefetch can only hide ~1 node of latency. Instead the whole
+  // batch's digest refs are FLATTENED into one stream and processed as a
+  // two-pass pipeline:
+  //   pass A: probe-only (seeded hash + 4-byte prefix, no memcmp, no
+  //           insertion) with the dtab line prefetched D entries ahead
+  //           and the hit's arena line prefetched for pass B;
+  //   pass B: exact memcmp on hits (line already in flight), full
+  //           intern (with insertion, in stream order) for misses and
+  //           the ~never filter false-positives — refid assignment order
+  //           is identical to the serial loop.
   size_t ref_off[kChildSlots];
-  uint64_t dh[kChildSlots + 1];
+  E.flat_d.clear();
+  E.flat_h.clear();
+  E.flat_out.clear();
   for (uint64_t k = 0; k < n_novel; ++k) {
     const uint64_t i = novel_idx[k];
     const uint8_t* p = ptrs[i];
     const uint32_t len = lens[i];
     E.insert_node(p, len, hash_bytes(p, len, E.seed),
                   static_cast<int32_t>(base_row + k));
+    const uint8_t* dg = digests + 32 * k;
+    E.flat_d.push_back(dg);
+    E.flat_h.push_back(hash_digest(dg, E.seed));
+    E.flat_out.push_back(&E.own_refid[base_row + k]);
     const int nref = node_refs(p, 0, len, ref_off);
-    // hash the node's own digest + every ref first and prefetch their
-    // probe slots — the ~17 intern probes per node are random-access
-    // bound, so overlapping their memory latency is the whole game
-    const uint64_t mask = E.dtab.size() - 1;
-    dh[0] = hash_digest(digests + 32 * k, E.seed);
-    for (int r = 0; r < nref; ++r)
-      dh[r + 1] = hash_digest(p + ref_off[r], E.seed);
-    for (int r = 0; r <= nref; ++r)
-      __builtin_prefetch(&E.dtab[dh[r] & mask]);
-    E.own_refid[base_row + k] = E.intern_digest_h(digests + 32 * k, dh[0]);
     int32_t* slots = E.child_refids.data() + (base_row + k) * kChildSlots;
-    for (int r = 0; r < nref; ++r)
-      slots[r] = E.intern_digest_h(p + ref_off[r], dh[r + 1]);
+    for (int r = 0; r < nref; ++r) {
+      const uint8_t* rd = p + ref_off[r];
+      E.flat_d.push_back(rd);
+      E.flat_h.push_back(hash_digest(rd, E.seed));
+      E.flat_out.push_back(&slots[r]);
+    }
+  }
+  const size_t F = E.flat_d.size();
+  // pre-grow so pass B insertions never rehash mid-stream
+  while ((E.n_digests + F + 1) * 10 >= E.dtab.size() * 7) E.grow_dtab();
+  const uint64_t mask = E.dtab.size() - 1;
+  E.flat_refid.assign(F, -2);
+  constexpr size_t D = 16;  // prefetch depth
+  for (size_t j = 0; j < F; ++j) {
+    if (j + D < F) __builtin_prefetch(&E.dtab[E.flat_h[j + D] & mask]);
+    const uint8_t* d = E.flat_d[j];
+    const uint64_t hh = E.flat_h[j];
+    uint32_t p4;
+    std::memcpy(&p4, d, 4);
+    uint64_t i = hh & mask;
+    int32_t found = -2;
+    while (true) {
+      const DigestEntry& e = E.dtab[i];
+      if (e.refid < 0) break;  // empty: slow path inserts in pass B
+      if (e.hash == hh && e.pre4 == p4) {
+        found = e.refid;
+        break;
+      }
+      i = (i + 1) & mask;
+    }
+    if (found >= 0) __builtin_prefetch(E.digest_arena.data() + 32 * found);
+    E.flat_refid[j] = found;
+  }
+  for (size_t j = 0; j < F; ++j) {
+    const int32_t f = E.flat_refid[j];
+    if (f >= 0 &&
+        std::memcmp(E.digest_arena.data() + 32 * f, E.flat_d[j], 32) == 0) {
+      *E.flat_out[j] = f;
+    } else {
+      *E.flat_out[j] = E.intern_digest_h(E.flat_d[j], E.flat_h[j]);
+    }
   }
   for (uint64_t i = 0; i < n; ++i)
     if (rows[i] < -1) rows[i] = base_row + (-2 - rows[i]);

@@ -448,9 +448,15 @@ class Blockchain:
 
     def _collect_requests(self, receipts, header: BlockHeader) -> bytes:
         from phant_tpu.blockchain import requests as req
+        from phant_tpu.utils.hexutils import hex_to_address
 
+        deposit_addr = req.DEPOSIT_CONTRACT_ADDRESS
+        if self.config is not None and getattr(
+            self.config, "depositContractAddress", None
+        ):
+            deposit_addr = hex_to_address(self.config.depositContractAddress)
         try:
-            deposits = req.extract_deposit_requests(receipts)
+            deposits = req.extract_deposit_requests(receipts, deposit_addr)
         except req.RequestsError as e:
             raise BlockError(str(e)) from e
         withdrawals = self._system_call(req.WITHDRAWAL_REQUEST_ADDRESS, header)

@@ -79,14 +79,17 @@ def parse_deposit_event_data(data: bytes) -> bytes:
     return pubkey + withdrawal_credentials + amount + signature + index
 
 
-def extract_deposit_requests(receipts: Sequence) -> bytes:
+def extract_deposit_requests(
+    receipts: Sequence, deposit_address: bytes = DEPOSIT_CONTRACT_ADDRESS
+) -> bytes:
     """Concatenated deposit requests from the block's receipts, in log
-    order (EIP-6110)."""
+    order (EIP-6110).  `deposit_address` is per-network (the chainspec's
+    depositContractAddress — Sepolia's differs from mainnet's)."""
     out = []
     for receipt in receipts:
         for log in receipt.logs:
             if (
-                log.address == DEPOSIT_CONTRACT_ADDRESS
+                log.address == deposit_address
                 and len(log.topics) >= 1
                 and log.topics[0] == DEPOSIT_EVENT_SIGNATURE_HASH
             ):

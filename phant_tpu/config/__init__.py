@@ -90,6 +90,10 @@ class ChainConfig:
     cancunTime: Optional[int] = None
     pragueTime: Optional[int] = None
     osakaTime: Optional[int] = None
+    # EIP-6110 (Prague): the beacon deposit contract whose logs become
+    # deposit requests — per-network (geth chainspec field); None falls
+    # back to the mainnet address
+    depositContractAddress: Optional[str] = None
 
     # ------------------------------------------------------------------
 

@@ -46,61 +46,59 @@ MAP_FP_GAS = 5500
 MAP_FP2_GAS = 23800
 MSM_MULTIPLIER = 1000
 
-# MSM discount tables, indexed by min(k, 128) - 1.  BEST-EFFORT embed:
-# transcribed from EIP-2537 but not verifiable in this zero-egress build —
-# override with PHANT_BLS_DISCOUNT_TABLE={"g1":[...128 ints],"g2":[...]}
-# before relying on gas-exactness for k>1 MSMs.  The k=1 entry (1000 = no
-# discount, MSM == MUL cost) and the saturation values (519/524) are
-# load-bearing and confident.
+# MSM discount tables, indexed by min(k, 128) - 1.  Only the anchor
+# entries are embedded: k=1 (1000 = no discount, MSM == MUL cost, defined
+# by the EIP's formula) and the k>=128 saturation values.  The 126
+# mid-curve entries are published constants that cannot be verified in
+# this zero-egress build, and the tree's policy is that an unverifiable
+# consensus constant must fail LOUDLY, not guess (a wrong discount is a
+# silent gas divergence) — supply the full tables via
+# PHANT_BLS_DISCOUNT_TABLE={"g1":[...128 ints],"g2":[...]} to enable
+# 2 <= k <= 127 MSMs.
 _G1_DISCOUNT_TAIL = 519
 _G2_DISCOUNT_TAIL = 524
 
 
-def _interp_table(tail: int) -> List[int]:
-    """Monotone best-effort discount curve from 1000 (k=1) to `tail`
-    (k>=128), harmonic-ish like the EIP's published tables."""
-    out = []
-    for k in range(1, 129):
-        if k == 1:
-            out.append(1000)
-        else:
-            # smooth 1/log-style decay calibrated to hit the tail at 128
-            import math
-
-            frac = math.log(k) / math.log(128)
-            out.append(round(1000 - (1000 - tail) * frac))
-    out[127] = tail
-    return out
-
-
-def _load_discounts() -> Tuple[List[int], List[int]]:
+def _load_discounts() -> Optional[Tuple[List[int], List[int]]]:
     src = os.environ.get("PHANT_BLS_DISCOUNT_TABLE")
-    if src:
-        with open(src) as f:
-            data = json.load(f)
-        g1, g2 = list(data["g1"]), list(data["g2"])
-        if len(g1) != 128 or len(g2) != 128:
-            raise ValueError("discount tables must have 128 entries each")
-        return g1, g2
-    return _interp_table(_G1_DISCOUNT_TAIL), _interp_table(_G2_DISCOUNT_TAIL)
+    if not src:
+        return None
+    with open(src) as f:
+        data = json.load(f)
+    g1, g2 = list(data["g1"]), list(data["g2"])
+    if len(g1) != 128 or len(g2) != 128:
+        raise ValueError("discount tables must have 128 entries each")
+    return g1, g2
 
 
 _DISCOUNTS: Optional[Tuple[List[int], List[int]]] = None
+_DISCOUNTS_LOADED = False
 
 
-def _discounts() -> Tuple[List[int], List[int]]:
-    global _DISCOUNTS
-    if _DISCOUNTS is None:
+def _discounts() -> Optional[Tuple[List[int], List[int]]]:
+    global _DISCOUNTS, _DISCOUNTS_LOADED
+    if not _DISCOUNTS_LOADED:
         _DISCOUNTS = _load_discounts()
+        _DISCOUNTS_LOADED = True
     return _DISCOUNTS
 
 
 def msm_gas(k: int, g2: bool) -> int:
     if k == 0:
         return 0
-    table = _discounts()[1 if g2 else 0]
-    disc = table[min(k, 128) - 1]
     per = G2MUL_GAS if g2 else G1MUL_GAS
+    if k == 1:
+        disc = 1000
+    elif k >= 128:
+        disc = _G2_DISCOUNT_TAIL if g2 else _G1_DISCOUNT_TAIL
+    else:
+        tables = _discounts()
+        if tables is None:
+            raise ConsensusDataUnavailable(
+                f"MSM gas for k={k} needs the EIP-2537 discount table "
+                "(unverifiable in this build; set PHANT_BLS_DISCOUNT_TABLE)"
+            )
+        disc = tables[1 if g2 else 0][k - 1]
     return k * per * disc // MSM_MULTIPLIER
 
 

@@ -138,29 +138,7 @@ class WitnessEngine:
     def _hash_batch(self, nodes: List[bytes]) -> List[bytes]:
         if self._hasher is not None:
             return list(self._hasher(nodes))
-        from phant_tpu.backend import (
-            crypto_backend,
-            device_offload_pays,
-            jax_device_ok,
-        )
-
-        # backend check FIRST: the adaptive gate probes the device link,
-        # which must never happen on the pure-CPU path (a dead tunnel would
-        # hang a run that never asked for a device)
-        from phant_tpu.crypto.keccak import RATE
-
-        # nodes at/over the kernel's absorb capacity (pad byte positions
-        # would fall past the gathered chunks) must take the native path —
-        # witnesses are untrusted input and the digest must never be
-        # silently wrong, matching pack_witness_fused's explicit raise
-        fits_device = all(
-            len(n) < WITNESS_MAX_CHUNKS * RATE for n in nodes
-        )
-        if crypto_backend() == "tpu" and jax_device_ok() and fits_device and (
-            device_offload_pays(sum(len(n) for n in nodes))
-            if self._device_batch_floor < 0
-            else len(nodes) >= self._device_batch_floor
-        ):
+        if self._device_route_wanted(nodes):
             try:
                 out = self._hash_batch_device(nodes)
                 self.stats["device_batches"] = (
@@ -220,14 +198,22 @@ class WitnessEngine:
 
         import jax
 
-        if (
-            os.environ.get("PHANT_ENGINE_SHARDED", "0") == "1"
-            and len(jax.devices()) > 1
-            and B % len(jax.devices()) == 0
-        ):
+        sharded = os.environ.get("PHANT_ENGINE_SHARDED", "auto")
+        if sharded == "auto":
+            # default ON with >1 REAL accelerator (the production
+            # multi-chip topology); the virtual CPU test mesh stays
+            # single-device unless explicitly opted in — its 8 "devices"
+            # share one core, so sharding there only costs compiles
+            use_sharded = (
+                len(jax.devices()) > 1
+                and jax.default_backend() != "cpu"
+            )
+        else:
+            use_sharded = sharded == "1"
+        if use_sharded and len(jax.devices()) > 1 and B % len(jax.devices()) == 0:
             # multi-chip novelty hashing: shard the node axis over the
-            # mesh (opt-in — shard_map compiles bypass the persistent
-            # cache and the toggle is not thread-safe, see parallel/mesh)
+            # mesh (default-safe: the sharded compile's cache-suspension
+            # window is lock-serialized, see parallel/mesh.py)
             from phant_tpu.parallel.mesh import (
                 make_mesh,
                 witness_digests_sharded,
@@ -460,7 +446,15 @@ class WitnessEngine:
                 st.flush()
                 novel, miss, total = st.scan(witnesses)
                 n_novel = len(novel)
-            if self._native_route_certain():
+            if self._native_route_certain() or not self._device_route_wanted(
+                novel
+            ):
+                # the routed hasher for THIS batch is the host: hash inside
+                # the extension, zero Python round trip.  (With the Pallas
+                # kernel the offload gate is open in principle, so the
+                # structural short-circuit alone no longer covers the
+                # common native case — the per-batch cost-model verdict
+                # does, at the price of one cached link-profile read.)
                 self.stats["hashed"] += n_novel
                 self.stats["native_batches"] = (
                     self.stats.get("native_batches", 0) + 1
@@ -474,6 +468,38 @@ class WitnessEngine:
             verdict = st.finish(None)
         self.stats["hits"] += total - miss
         return np.frombuffer(verdict, np.uint8).astype(bool)
+
+    def _device_route_wanted(self, nodes: List[bytes]) -> bool:
+        """THE routing predicate: would this batch go to the device?
+        Shared by _hash_batch (which acts on it) and _verify_ext (which
+        uses it to keep the zero-round-trip finish_native fast path for
+        host-routed batches), so the two can never disagree.
+
+        A bench hasher override returns True — the batch must surface to
+        the Python-visible path for the override to apply."""
+        from phant_tpu.backend import (
+            crypto_backend,
+            device_offload_pays,
+            jax_device_ok,
+        )
+        from phant_tpu.crypto.keccak import RATE
+
+        if self._hasher is not None:
+            return True
+        # backend check FIRST: the adaptive gate probes the device link,
+        # which must never happen on the pure-CPU path (a dead tunnel
+        # would hang a run that never asked for a device)
+        if crypto_backend() != "tpu" or not jax_device_ok():
+            return False
+        # nodes at/over the kernel's absorb capacity (pad byte positions
+        # would fall past the gathered chunks) must take the native path —
+        # witnesses are untrusted input and the digest must never be
+        # silently wrong, matching pack_witness_fused's explicit raise
+        if any(len(n) >= WITNESS_MAX_CHUNKS * RATE for n in nodes):
+            return False
+        if self._device_batch_floor >= 0:
+            return len(nodes) >= self._device_batch_floor
+        return device_offload_pays(sum(len(n) for n in nodes))
 
     def _native_route_certain(self) -> bool:
         """True when _hash_batch could only ever pick the native hasher —

@@ -42,6 +42,15 @@ else:  # pragma: no cover - older jax
 
 
 import contextlib
+import threading
+
+# serializes the cache-suspension window below: the config flip is
+# process-global, so concurrent sharded compiles must take turns. A
+# single-device compile racing the window at worst skips one persistent-
+# cache write (benign; its in-memory executable is unaffected) — there is
+# no corruption mode, which is what makes the sharded path default-safe
+# in threaded servers.
+_CACHE_TOGGLE_LOCK = threading.RLock()
 
 
 @contextlib.contextmanager
@@ -50,22 +59,21 @@ def _no_compile_cache():
     image's jaxlib in the persistent compilation cache's write path
     (reproduced deterministically with a fresh single-writer cache dir), so
     every sharded compile below runs with the cache suspended. Single-device
-    kernels keep the cache — their serialization is fine. Not thread-safe
-    (global config toggle); the sharded entry points are driver/bench/test
-    paths, never the threaded Engine API server."""
-    try:
-        prev = jax.config.jax_compilation_cache_dir
-    except AttributeError:  # pragma: no cover - much older jax
-        yield
-        return
-    if prev is None:
-        yield
-        return
-    jax.config.update("jax_compilation_cache_dir", None)
-    try:
-        yield
-    finally:
-        jax.config.update("jax_compilation_cache_dir", prev)
+    kernels keep the cache — their serialization is fine."""
+    with _CACHE_TOGGLE_LOCK:
+        try:
+            prev = jax.config.jax_compilation_cache_dir
+        except AttributeError:  # pragma: no cover - much older jax
+            yield
+            return
+        if prev is None:
+            yield
+            return
+        jax.config.update("jax_compilation_cache_dir", None)
+        try:
+            yield
+        finally:
+            jax.config.update("jax_compilation_cache_dir", prev)
 
 
 def make_mesh(n_devices: Optional[int] = None, axis: str = "dp") -> Mesh:

@@ -57,6 +57,10 @@ def parse_alloc(alloc: dict) -> Dict[bytes, Account]:
 def load_fixture_file(path: Path) -> Iterator[Fixture]:
     data = json.loads(Path(path).read_text())
     for name, fx in data.items():
+        if not isinstance(fx, dict) or "blocks" not in fx:
+            # not a blockchain-test entry (e.g. the mainnet tx golden
+            # corpus shares tests/fixtures/) — other harnesses own it
+            continue
         blocks = [
             FixtureBlock(
                 rlp=hex_to_bytes(b["rlp"]),

@@ -161,24 +161,28 @@ def run_fixture_stateless(fixture: Fixture) -> None:
     block must be rejected statelessly too. A full-state shadow chain rolls
     the canonical state forward between blocks (it is the witness source,
     exactly the role a stateful node plays for a stateless client)."""
-    from phant_tpu.blockchain.fork import FrontierFork
+    from phant_tpu.blockchain.fork import CancunFork, FrontierFork, PragueFork
     from phant_tpu.stateless import StatelessError, execute_stateless
 
-    if any(n in fixture.network.lower() for n in ("cancun", "prague", "osaka")):
-        # Cancun/Prague-family blocks write fork system slots (EIP-4788
-        # beacon roots / EIP-2935 history) into the post root; the stateless
-        # re-run would need the fork constructed over the witness state —
-        # fail loudly rather than mis-root (the STATEFUL runner covers
-        # these networks with the right fork)
-        raise FixtureFailure(
-            f"{fixture.name}: stateless runner has no fork config for "
-            f"network {fixture.network!r}"
-        )
+    # fork-varying system state (EIP-4788 beacon roots, EIP-2935 history)
+    # is part of the post root, so the stateless side constructs the SAME
+    # fork class over the witness-backed state (fork_factory) that the
+    # shadow chain uses over the full state
+    net = fixture.network.lower()
+    if "prague" in net or "osaka" in net:
+        fork_cls = PragueFork
+    elif "cancun" in net:
+        fork_cls = CancunFork
+    else:
+        fork_cls = None  # stateless FrontierFork (no state binding)
 
     state = StateDB({addr: acct.copy() for addr, acct in fixture.pre.items()})
     genesis = Block.decode(fixture.genesis_rlp)
     shadow = Blockchain(
-        chain_id=1, state=state, parent_header=genesis.header
+        chain_id=1,
+        state=state,
+        parent_header=genesis.header,
+        fork=fork_cls(state) if fork_cls else None,
     )
 
     past_headers = [genesis.header]
@@ -191,12 +195,28 @@ def run_fixture_stateless(fixture: Fixture) -> None:
         except (rlp.DecodeError, ValueError, KeyError, IndexError):
             decode_ok = False
         if decode_ok:
-            fork = FrontierFork()
-            for h in past_headers[-256:]:
-                fork.update_parent_block_hash(h.block_number, h.hash())
+            # ONE factory for every fork class, primed with the
+            # authenticated ancestor hashes; built AGAINST THE WITNESS
+            # STATE when the class binds state (FrontierFork ignores it)
+            ancestors = [
+                (h.block_number, h.hash()) for h in past_headers[-256:]
+            ]
+
+            def fork_factory(st, _anc=ancestors):
+                f = fork_cls(st) if fork_cls is not None else FrontierFork()
+                for num, hsh in _anc:
+                    f.update_parent_block_hash(num, hsh)
+                return f
+
             try:
                 _result, post_root = execute_stateless(
-                    1, parent, block, pre_root, nodes, codes, fork=fork
+                    1,
+                    parent,
+                    block,
+                    pre_root,
+                    nodes,
+                    codes,
+                    fork_factory=fork_factory,
                 )
                 stateless_ok = True
             except (StatelessError, BlockError, ValueError, KeyError, IndexError) as e:

@@ -188,17 +188,34 @@ def test_bls_g2_add():
     assert out.output == _enc_g2(bls.g2_mul(q, 2))
 
 
-def test_bls_g1_msm():
+def test_bls_g1_msm(tmp_path, monkeypatch):
     g = bls.G1_GEN
     pairs = _enc_g1(g) + (2).to_bytes(32, "big")
     pairs += _enc_g1(bls.g1_mul(g, 2)) + (3).to_bytes(32, "big")
+    # k=2 without the (unverifiable-offline) discount table: LOUD gap
+    monkeypatch.delenv("PHANT_BLS_DISCOUNT_TABLE", raising=False)
+    pb._DISCOUNTS_LOADED = False
+    with pytest.raises(pb.ConsensusDataUnavailable):
+        pb.bls_g1_msm(pairs, 100_000)
+    # with an operator-supplied table the formula applies as specified
+    import json
+
+    table = tmp_path / "discounts.json"
+    table.write_text(
+        json.dumps({"g1": [1000] + [900] * 127, "g2": [1000] + [910] * 127})
+    )
+    monkeypatch.setenv("PHANT_BLS_DISCOUNT_TABLE", str(table))
+    pb._DISCOUNTS_LOADED = False
     out = pb.bls_g1_msm(pairs, 100_000)
     assert out.success
     assert out.output == _enc_g1(bls.g1_mul(g, 8))
-    assert out.gas_left == 100_000 - pb.msm_gas(2, g2=False)
-    # k=1 MSM costs exactly the MUL price (discount 1000)
+    assert out.gas_left == 100_000 - (2 * pb.G1MUL_GAS * 900) // 1000
+    pb._DISCOUNTS_LOADED = False
+    # anchor entries need no table: k=1 == MUL price; k>=128 saturates
+    monkeypatch.delenv("PHANT_BLS_DISCOUNT_TABLE", raising=False)
     assert pb.msm_gas(1, g2=False) == pb.G1MUL_GAS
     assert pb.msm_gas(1, g2=True) == pb.G2MUL_GAS
+    assert pb.msm_gas(128, g2=False) == (128 * pb.G1MUL_GAS * 519) // 1000
 
 
 def test_bls_g2_msm():
