@@ -101,26 +101,46 @@ def device_link_profile() -> tuple:
         # compressing transport must not flatter the probe, jax dedupes a
         # repeated transfer of the same host buffer (observed: the second
         # sample of one array measured ~0s -> a petabytes/s "link"), and
-        # RNG generation must stay OUTSIDE the timed window (1MB of PCG64
-        # costs ~ms — more than the transfer itself on a fast link)
+        # RNG generation must stay OUTSIDE the timed window.
+        # TWO sizes, bandwidth from the SLOPE: a single small transfer
+        # minus RTT is meaningless on a relay-buffered tunnel (observed:
+        # 1MB "measured" 576 MB/s on a ~40 MB/s link because the relay
+        # acks the write into its buffer; the r4 gate was structurally
+        # closed so the poisoned number never routed anything — the open
+        # gate made it ship 39MB state-root plans into a 700s timeout).
+        # The big buffer must be large enough that transfer time >> RTT.
         rng = np.random.default_rng(0)
-        size = 1 << 20
-        warm_buf, *bufs = (
-            rng.integers(0, 256, size, dtype=np.uint8) for _ in range(3)
-        )
+        size_small = 1 << 20
+        size_big = 12 << 20
+        warm_buf = rng.integers(0, 256, size_small, dtype=np.uint8)
+        buf_small = rng.integers(0, 256, size_small, dtype=np.uint8)
+        buf_big = rng.integers(0, 256, size_big, dtype=np.uint8)
         # sum the WHOLE buffer: consuming only a slice lets the transport
         # defer most of the transfer (observed: a sliced readback clocked
         # the 1MB upload at the 50 GB/s sanity clamp). The on-device sum
-        # of 1MB is noise next to any real link time.
+        # is noise next to any real link time.
         int(jnp.sum(jnp.asarray(warm_buf)))  # warm transfer path
-        up = min(
-            _timed(lambda b=b: int(jnp.sum(jnp.asarray(b))), time)
-            for b in bufs
+        # min-of-3 per size (same rationale as the latency probe: one
+        # scheduler hiccup must not skew routing for the process lifetime)
+        t_small = min(
+            _timed(lambda: int(jnp.sum(jnp.asarray(buf_small))), time)
+            for _ in range(3)
         )
-        # floor at a 50 GB/s physical ceiling: no real link is faster, so
-        # anything quicker is a caching artifact, not bandwidth
-        up = max(up - lat, size / 50e9)
-        _LINK_PROFILE = (size / up, lat)
+        t_big = min(
+            _timed(lambda: int(jnp.sum(jnp.asarray(buf_big))), time)
+            for _ in range(3)
+        )
+        # slope over the size delta cancels RTT and fixed dispatch costs.
+        # A non-positive slope means the probe is unusable (a hiccup ate
+        # t_small) — report a dead link for the TTL rather than clamp to
+        # a ceiling the tunnel cannot possibly have.
+        delta = t_big - t_small
+        if delta <= 0:
+            _LINK_FAIL_UNTIL = _time.monotonic() + _LINK_FAIL_TTL_S
+            return (1.0, 3600.0)
+        # floor at a 50 GB/s physical ceiling (no real link is faster)
+        up = max(delta, (size_big - size_small) / 50e9)
+        _LINK_PROFILE = ((size_big - size_small) / up, lat)
     except Exception:
         # probe failure: report an unusable link and back off for a TTL —
         # neither extreme is right (r2 pinned never-offload for the whole

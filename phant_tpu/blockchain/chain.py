@@ -267,7 +267,13 @@ class Blockchain:
         """Cancun dispatch: the chain config's schedule when present, else
         the header's own blob-gas fields (fixtures and synthetic chains are
         self-describing). The reference pins EVMC_SHANGHAI with a TODO
-        (src/blockchain/vm.zig:472); this is that TODO done."""
+        (src/blockchain/vm.zig:472); this is that TODO done.
+
+        The header-trusting fallback is for CONFIG-LESS chains only —
+        trusted inputs by construction (fixtures, synthetic benches).
+        Every network entry point (the Engine API server, __main__)
+        constructs its Blockchain with a config, so untrusted payload
+        bytes never pick their own fork here."""
         if self.config is not None:
             name = self.config.fork_at(header.block_number, header.timestamp)
             return name in ("cancun", "prague", "osaka")

@@ -135,10 +135,14 @@ class WitnessEngine:
 
     # -- hashing backends ---------------------------------------------------
 
-    def _hash_batch(self, nodes: List[bytes]) -> List[bytes]:
+    def _hash_batch(
+        self, nodes: List[bytes], route_device: Optional[bool] = None
+    ) -> List[bytes]:
         if self._hasher is not None:
             return list(self._hasher(nodes))
-        if self._device_route_wanted(nodes):
+        if route_device is None:
+            route_device = self._device_route_wanted(nodes)
+        if route_device:
             try:
                 out = self._hash_batch_device(nodes)
                 self.stats["device_batches"] = (
@@ -446,9 +450,10 @@ class WitnessEngine:
                 st.flush()
                 novel, miss, total = st.scan(witnesses)
                 n_novel = len(novel)
-            if self._native_route_certain() or not self._device_route_wanted(
-                novel
-            ):
+            route_device = not self._native_route_certain() and (
+                self._device_route_wanted(novel)
+            )
+            if not route_device:
                 # the routed hasher for THIS batch is the host: hash inside
                 # the extension, zero Python round trip.  (With the Pallas
                 # kernel the offload gate is open in principle, so the
@@ -461,7 +466,7 @@ class WitnessEngine:
                 )
                 verdict = st.finish_native()
             else:
-                digests = self._hash_batch(novel)
+                digests = self._hash_batch(novel, route_device=True)
                 self.stats["hashed"] += n_novel
                 verdict = st.finish(b"".join(digests))
         else:

@@ -57,9 +57,13 @@ def parse_alloc(alloc: dict) -> Dict[bytes, Account]:
 def load_fixture_file(path: Path) -> Iterator[Fixture]:
     data = json.loads(Path(path).read_text())
     for name, fx in data.items():
-        if not isinstance(fx, dict) or "blocks" not in fx:
+        if not isinstance(fx, dict) or name.startswith("_"):
             # not a blockchain-test entry (e.g. the mainnet tx golden
-            # corpus shares tests/fixtures/) — other harnesses own it
+            # corpus shares tests/fixtures/: an "_info" dict + a
+            # "transactions" list) — other harnesses own those. A dict
+            # entry MISSING required keys still fails loudly below;
+            # skipping on absent "blocks" would let truncated fixtures
+            # silently drop out of the suite.
             continue
         blocks = [
             FixtureBlock(
