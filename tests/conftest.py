@@ -66,17 +66,12 @@ enable_compile_cache()
 import pytest  # noqa: E402
 
 
-@pytest.fixture(params=["python", "native", "tpu"])
-def evm_backend(request):
-    """Run a test across backend combinations: "python"/"native" diff the two
-    EVM backends (the C++ core is the reference's evmone analog) on the cpu
-    crypto backend; "tpu" runs the native EVM with `--crypto_backend=tpu`
-    (batched jax ecrecover + device trie roots on the CPU mesh), so the whole
-    pipeline is differentially verified end-to-end (SURVEY §4)."""
+def _backend_combo(param: str):
+    """Shared backend-switching protocol for the evm_backend* fixtures:
+    one place owns the skip condition, the set, and the teardown."""
     from phant_tpu.backend import set_crypto_backend, set_evm_backend
     from phant_tpu.evm.native_vm import native_available
 
-    param = request.param
     if param in ("native", "tpu") and not native_available():
         pytest.skip("native toolchain unavailable")
     set_evm_backend("python" if param == "python" else "native")
@@ -84,3 +79,25 @@ def evm_backend(request):
     yield param
     set_evm_backend("python")
     set_crypto_backend("cpu")
+
+
+@pytest.fixture(params=["python", "native", "tpu"])
+def evm_backend(request):
+    """Run a test across backend combinations: "python"/"native" diff the two
+    EVM backends (the C++ core is the reference's evmone analog) on the cpu
+    crypto backend; "tpu" runs the native EVM with `--crypto_backend=tpu`
+    (batched jax ecrecover + device trie roots on the CPU mesh), so the whole
+    pipeline is differentially verified end-to-end (SURVEY §4)."""
+    yield from _backend_combo(request.param)
+
+
+@pytest.fixture(params=["python", "native"])
+def evm_backend_cpu(request):
+    """The two EVM backends on the cpu crypto backend only.  For test
+    families whose per-test "tpu" value is redundant: the tpu param
+    exercises the SAME batched-jax sender-recovery/trie code for every
+    test in a family (it has no per-test surface), and each run costs
+    seconds of XLA-CPU kernel execution on the gate's one core — so a
+    family keeps a couple of representative 3-backend tests on
+    `evm_backend` and runs the rest here (VERDICT r4 #10: gate time)."""
+    yield from _backend_combo(request.param)

@@ -214,7 +214,7 @@ def test_delegated_execution_in_authority_context(evm_backend):
     assert block.header.gas_used >= 21_000 + G.PER_EMPTY_ACCOUNT_COST
 
 
-def test_clear_delegation_with_zero_address(evm_backend):
+def test_clear_delegation_with_zero_address(evm_backend_cpu):
     signer = TxSigner(CHAIN_ID)
     pre = {
         AUTHORITY: Account(
@@ -228,7 +228,7 @@ def test_clear_delegation_with_zero_address(evm_backend):
     assert state.get_nonce(AUTHORITY) == 1
 
 
-def test_tuple_skips_never_invalidate_tx(evm_backend):
+def test_tuple_skips_never_invalidate_tx(evm_backend_cpu):
     """Bad tuples (wrong chain, wrong nonce, contract-coded authority) are
     skipped; good tuples in the same list still apply."""
     signer = TxSigner(CHAIN_ID)
@@ -248,7 +248,7 @@ def test_tuple_skips_never_invalidate_tx(evm_backend):
     assert state.get_nonce(contract_authority) == 0
 
 
-def test_delegated_sender_allowed_by_amended_3607(evm_backend):
+def test_delegated_sender_allowed_by_amended_3607(evm_backend_cpu):
     """An EOA carrying a delegation designator may originate transactions
     (EIP-3607 as amended by EIP-7702) — here the delegated AUTHORITY sends
     a plain value transfer."""
@@ -272,7 +272,7 @@ def test_delegated_sender_allowed_by_amended_3607(evm_backend):
     assert state.get_nonce(AUTHORITY) == 5
 
 
-def test_extcode_views_see_marker(evm_backend):
+def test_extcode_views_see_marker(evm_backend_cpu):
     """EXTCODESIZE/EXTCODECOPY/EXTCODEHASH on a delegated account operate
     on the 2-byte 0xef01 marker, not the designator or delegate code."""
     signer = TxSigner(CHAIN_ID)
@@ -362,7 +362,7 @@ def test_set_code_tx_rejected_before_prague():
         )
 
 
-def test_delegation_chain_does_not_recurse(evm_backend):
+def test_delegation_chain_does_not_recurse(evm_backend_cpu):
     """A designator pointing at another delegated account executes the raw
     designator bytes (halting on 0xEF) instead of following the chain."""
     signer = TxSigner(CHAIN_ID)
@@ -424,7 +424,7 @@ def test_nested_call_to_delegated_gas_identical_across_backends():
 # ---------------------------------------------------------------------------
 
 
-def test_calldata_floor_binds_for_data_heavy_tx(evm_backend):
+def test_calldata_floor_binds_for_data_heavy_tx(evm_backend_cpu):
     """A calldata-heavy tx with trivial execution pays the EIP-7623 floor
     (21000 + 10/token), not the cheaper 4/16-per-byte metered cost."""
     from phant_tpu.types.transaction import FeeMarketTx
@@ -446,7 +446,7 @@ def test_calldata_floor_binds_for_data_heavy_tx(evm_backend):
     assert block.header.gas_used == floor
 
 
-def test_calldata_floor_does_not_bind_compute_heavy_tx(evm_backend):
+def test_calldata_floor_does_not_bind_compute_heavy_tx(evm_backend_cpu):
     """Execution above the floor is charged normally — the floor is a
     minimum, not a surcharge."""
     from phant_tpu.types.transaction import FeeMarketTx
