@@ -3,8 +3,8 @@
 Self-generated oracle (the official execution-spec-tests Cancun corpus is
 not fetchable in this zero-egress build): blocks are built and executed
 with the python EVM backend, headers carry the real computed
-gas/roots/bloom/state-root, and every emitted fixture is immediately
-re-verified through phant_tpu.spec.runner before being written.  The
+gas/roots/bloom/state-root, and every emitted fixture is re-verified
+through the stateful AND stateless runners before being written.  The
 test suite then drives them through all three backends + the stateless
 re-run like every other fixture (tests/test_spec_fixtures.py).
 
@@ -17,160 +17,50 @@ under the dev KZG setup.
 Usage: python scripts/gen_cancun_fixtures.py  (writes tests/fixtures/cancun/)
 """
 
-import json
+import functools
 import os
 import sys
 from dataclasses import replace as drep
 
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-from phant_tpu.blockchain.chain import Blockchain, calculate_base_fee
-from phant_tpu.blockchain.fork import BEACON_ROOTS_ADDRESS, CancunFork
-from phant_tpu.crypto import secp256k1 as secp
-from phant_tpu.mpt.mpt import EMPTY_TRIE_ROOT, ordered_trie_root
-from phant_tpu.signer.signer import TxSigner, address_from_pubkey
-from phant_tpu.state.statedb import StateDB
-from phant_tpu.types.account import Account
-from phant_tpu.types.block import Block, BlockHeader
-from phant_tpu.types.receipt import logs_bloom
-from phant_tpu.types.transaction import BlobTx, FeeMarketTx
+from fixturegen import (  # noqa: E402
+    build_block,
+    dump_state,
+    fee_tx,
+    fixture_entry,
+    hex_,
+    make_genesis,
+    write_and_verify,
+)
+
+from phant_tpu.blockchain.fork import BEACON_ROOTS_ADDRESS, CancunFork  # noqa: E402
+from phant_tpu.crypto import secp256k1 as secp  # noqa: E402
+from phant_tpu.signer.signer import TxSigner, address_from_pubkey  # noqa: E402
+from phant_tpu.state.statedb import StateDB  # noqa: E402  (re-export path)
+from phant_tpu.types.account import Account  # noqa: E402
+from phant_tpu.types.block import Block  # noqa: E402
+from phant_tpu.types.transaction import BlobTx  # noqa: E402
 
 CHAIN_ID = 1
 SENDER_KEY = 0x45A915E4D060149EB4365960E6A7A45F334393093061116B197E3240065FF2D8
 SENDER = address_from_pubkey(secp.pubkey_of(SENDER_KEY))
 GENESIS_TS = 0x10000000
 BLOCK_TS = GENESIS_TS + 12
-GWEI = 10**9
+
+_build = functools.partial(build_block, fork_cls=CancunFork, genesis_ts=GENESIS_TS)
+_fixture = functools.partial(
+    fixture_entry,
+    network="Cancun",
+    genesis_ts=GENESIS_TS,
+    generator="scripts/gen_cancun_fixtures.py",
+)
+_fee_tx = functools.partial(fee_tx, SENDER_KEY)
 
 
 def _addr(n: int) -> bytes:
     return n.to_bytes(20, "big")
-
-
-def _hex(b: bytes) -> str:
-    return "0x" + b.hex()
-
-
-def _alloc_json(accounts) -> dict:
-    out = {}
-    for addr, acct in sorted(accounts.items()):
-        out[_hex(addr)] = {
-            "nonce": hex(acct.nonce),
-            "balance": hex(acct.balance),
-            "code": _hex(acct.code),
-            "storage": {hex(k): hex(v) for k, v in sorted(acct.storage.items()) if v},
-        }
-    return out
-
-
-def _dump_state(state: StateDB) -> dict:
-    return {
-        addr: Account(
-            nonce=a.nonce,
-            balance=a.balance,
-            code=a.code,
-            storage={k: v for k, v in a.storage.items() if v},
-        )
-        for addr, a in state.accounts.items()
-        if not (a.is_empty() and not a.storage)
-    }
-
-
-def _genesis(pre: dict) -> Block:
-    state = StateDB({a: acct.copy() for a, acct in pre.items()})
-    header = BlockHeader(
-        block_number=0,
-        gas_limit=30_000_000,
-        gas_used=0,
-        timestamp=GENESIS_TS,
-        base_fee_per_gas=7,
-        state_root=state.state_root(),
-        withdrawals_root=EMPTY_TRIE_ROOT,
-        blob_gas_used=0,
-        excess_blob_gas=0,
-        parent_beacon_block_root=b"\x00" * 32,
-    )
-    return Block(header=header, transactions=(), withdrawals=())
-
-
-def _build_block(pre: dict, txs, beacon_root: bytes, blob_gas_used: int = 0):
-    """Execute txs on a builder chain, return the finalized valid Block."""
-    genesis = _genesis(pre)
-    state = StateDB({a: acct.copy() for a, acct in pre.items()})
-    chain = Blockchain(
-        CHAIN_ID, state, genesis.header, fork=CancunFork(state),
-        verify_state_root=False,
-    )
-    base_fee = calculate_base_fee(30_000_000, 0, 7)
-    draft = BlockHeader(
-        parent_hash=genesis.header.hash(),
-        block_number=1,
-        gas_limit=30_000_000,
-        gas_used=0,
-        timestamp=BLOCK_TS,
-        base_fee_per_gas=base_fee,
-        transactions_root=ordered_trie_root([t.encode() for t in txs]),
-        receipts_root=EMPTY_TRIE_ROOT,
-        withdrawals_root=EMPTY_TRIE_ROOT,
-        logs_bloom=logs_bloom([]),
-        blob_gas_used=blob_gas_used,
-        excess_blob_gas=0,
-        parent_beacon_block_root=beacon_root,
-    )
-    # full block effects, mirroring Blockchain._execute_block
-    chain.fork.update_parent_block_hash(0, genesis.header.hash())
-    chain.fork.on_block_start(draft)
-    result = chain.apply_body(
-        Block(header=draft, transactions=tuple(txs), withdrawals=())
-    )
-    header = drep(
-        draft,
-        gas_used=result.gas_used,
-        receipts_root=ordered_trie_root([r.encode() for r in result.receipts]),
-        logs_bloom=result.logs_bloom,
-        state_root=state.state_root(),
-    )
-    return genesis, Block(header=header, transactions=tuple(txs), withdrawals=()), state
-
-
-def _fixture(name: str, pre: dict, blocks, last_block: Block, post: dict) -> dict:
-    genesis = _genesis(pre)
-    return {
-        name: {
-            "_info": {
-                "comment": (
-                    "self-generated by scripts/gen_cancun_fixtures.py "
-                    "(python EVM backend oracle, re-verified on emit)"
-                )
-            },
-            "network": "Cancun",
-            "genesisRLP": _hex(genesis.encode()),
-            "genesisBlockHeader": {
-                "hash": _hex(genesis.header.hash()),
-                "stateRoot": _hex(genesis.header.state_root),
-            },
-            "blocks": blocks,
-            "lastblockhash": _hex(last_block.header.hash()),
-            "pre": _alloc_json(pre),
-            "postState": _alloc_json(post),
-            "sealEngine": "NoProof",
-        }
-    }
-
-
-def _signer() -> TxSigner:
-    return TxSigner(CHAIN_ID)
-
-
-def _fee_tx(to, data=b"", nonce=0, gas=500_000, value=0):
-    return _signer().sign(
-        FeeMarketTx(
-            chain_id_val=CHAIN_ID, nonce=nonce, max_priority_fee_per_gas=1,
-            max_fee_per_gas=1000, gas_limit=gas, to=to, value=value,
-            data=data, access_list=(), y_parity=0, r=0, s=0,
-        ),
-        SENDER_KEY,
-    )
 
 
 # --- scenario contracts -----------------------------------------------------
@@ -178,22 +68,19 @@ def _fee_tx(to, data=b"", nonce=0, gas=500_000, value=0):
 BLOBHASH_STORE = _addr(0xB10B)
 # BLOBHASH(0) -> SSTORE(0); BLOBHASH(1) -> SSTORE(1); BLOBBASEFEE -> SSTORE(2)
 BLOBHASH_STORE_CODE = bytes.fromhex(
-    "600049600055"  # PUSH1 0 BLOBHASH PUSH1 0 SSTORE
-    "600149600155"  # PUSH1 1 BLOBHASH PUSH1 1 SSTORE
-    "4a600255"      # BLOBBASEFEE PUSH1 2 SSTORE
-    "00"
+    "600049600055" "600149600155" "4a600255" "00"
 )
 
 CANCUN_OPS = _addr(0xCA7C)
 # TSTORE(0,42); TLOAD(0)->SSTORE(1); MSTORE(0,0xdead..); MCOPY(32,0,32);
 # MLOAD(32)->SSTORE(3)
 CANCUN_OPS_CODE = bytes.fromhex(
-    "602a5f5d"        # PUSH1 42 PUSH0 TSTORE
-    "5f5c600155"      # PUSH0 TLOAD PUSH1 1 SSTORE
+    "602a5f5d"
+    "5f5c600155"
     "7fdeadbeef00000000000000000000000000000000000000000000000000000001"
-    "5f52"            # MSTORE(0, X)
-    "60205f60205e"    # PUSH1 32 PUSH0 PUSH1 32 MCOPY (dst=32 src=0 len=32)
-    "602051600355"    # MLOAD(32) PUSH1 3 SSTORE
+    "5f52"
+    "60205f60205e"
+    "602051600355"
     "00"
 )
 
@@ -204,25 +91,24 @@ def beacon_read_code(ts: int) -> bytes:
     # MSTORE(0, ts); CALL(0xfffff gas, 4788, 0, 0, 32, 32, 32); store
     # success at slot 1 and the returned root at slot 0
     return (
-        b"\x7f" + ts.to_bytes(32, "big") + bytes.fromhex("5f52")  # MSTORE(0,ts)
+        b"\x7f" + ts.to_bytes(32, "big") + bytes.fromhex("5f52")
         + bytes.fromhex("6020602060205f5f73") + BEACON_ROOTS_ADDRESS
-        + bytes.fromhex("620fffff")  # PUSH3 gas
-        + bytes.fromhex("f1600155")  # CALL; SSTORE(1, success)
-        + bytes.fromhex("602051600055")  # SSTORE(0, MLOAD(32))
+        + bytes.fromhex("620fffff")
+        + bytes.fromhex("f1600155")
+        + bytes.fromhex("602051600055")
         + b"\x00"
     )
 
 
 POINT_EVAL = _addr(0x4E4A)
-# CALLDATACOPY(0,0,192); CALL(all gas, 0x0A, 0, 0, 192, 0xc0, 64);
+# CALLDATACOPY(0,0,192); CALL(gas, 0x0A, 0, 0, 192, 0xc0, 64);
 # SSTORE(0, success); SSTORE(1, MLOAD(0xc0)); SSTORE(2, MLOAD(0xe0))
 POINT_EVAL_CODE = bytes.fromhex(
-    "60c05f5f37"          # PUSH1 0xc0 PUSH0 PUSH0 CALLDATACOPY
-    "604060c060c05f5f600a620fffff"  # retSize 64, retOff 0xc0, argsSize 0xc0,
-                                     # argsOff 0, value 0, addr 0x0a, gas
-    "f1600055"            # CALL; SSTORE(0, success)
-    "60c051600155"        # SSTORE(1, MLOAD(0xc0))
-    "60e051600255"        # SSTORE(2, MLOAD(0xe0))
+    "60c05f5f37"
+    "604060c060c05f5f600a620fffff"
+    "f1600055"
+    "60c051600155"
+    "60e051600255"
     "00"
 )
 
@@ -258,7 +144,7 @@ def _base_pre(*contracts) -> dict:
 def gen_blob_tx_fixtures() -> dict:
     pre = _base_pre((BLOBHASH_STORE, BLOBHASH_STORE_CODE))
     vh = [b"\x01" + bytes(30) + bytes([i + 1]) for i in range(2)]
-    tx = _signer().sign(
+    tx = TxSigner(CHAIN_ID).sign(
         BlobTx(
             chain_id_val=CHAIN_ID, nonce=0, max_priority_fee_per_gas=1,
             max_fee_per_gas=1000, gas_limit=200_000, to=BLOBHASH_STORE,
@@ -268,36 +154,27 @@ def gen_blob_tx_fixtures() -> dict:
         SENDER_KEY,
     )
     beacon = b"\x42" * 32
-    genesis, block, state = _build_block(
-        pre, [tx], beacon, blob_gas_used=131072 * 2
+    genesis, block, state = _build(
+        pre, [tx], beacon_root=beacon, blob_gas_used=131072 * 2
     )
-    post = _dump_state(state)
-    # the contract pinned BLOBHASH(0)/(1) and BLOBBASEFEE
+    post = dump_state(state)
     assert post[BLOBHASH_STORE].storage[0] == int.from_bytes(vh[0], "big")
     assert post[BLOBHASH_STORE].storage[1] == int.from_bytes(vh[1], "big")
     assert post[BLOBHASH_STORE].storage[2] == 1  # min blob base fee
 
     out = _fixture(
-        "blob_tx_blobhash_blobbasefee",
-        pre,
-        [{"rlp": _hex(block.encode())}],
-        block,
-        post,
+        "blob_tx_blobhash_blobbasefee", pre,
+        [{"rlp": hex_(block.encode())}], block, post,
     )
     # the same block with a LYING blobGasUsed header must be rejected
     bad_header = drep(block.header, blob_gas_used=131072)
     bad = Block(header=bad_header, transactions=block.transactions, withdrawals=())
     out.update(
         _fixture(
-            "blob_gas_used_header_mismatch",
-            pre,
-            [
-                {
-                    "rlp": _hex(bad.encode()),
-                    "expectException": "blob gas used mismatch",
-                }
-            ],
-            _genesis(pre),  # no valid blocks: last hash = genesis
+            "blob_gas_used_header_mismatch", pre,
+            [{"rlp": hex_(bad.encode()),
+              "expectException": "blob gas used mismatch"}],
+            make_genesis(pre, GENESIS_TS),  # no valid blocks
             pre,
         )
     )
@@ -307,25 +184,22 @@ def gen_blob_tx_fixtures() -> dict:
 def gen_beacon_root_fixture() -> dict:
     pre = _base_pre((BEACON_READ, beacon_read_code(BLOCK_TS)))
     beacon = b"\x5a" * 32
-    tx = _fee_tx(BEACON_READ)
-    genesis, block, state = _build_block(pre, [tx], beacon)
-    post = _dump_state(state)
+    genesis, block, state = _build(pre, [_fee_tx(BEACON_READ)], beacon_root=beacon)
+    post = dump_state(state)
     assert post[BEACON_READ].storage[0] == int.from_bytes(beacon, "big")
     assert post[BEACON_READ].storage[1] == 1
     return _fixture(
-        "beacon_root_contract_readback",
-        pre,
-        [{"rlp": _hex(block.encode())}],
-        block,
-        post,
+        "beacon_root_contract_readback", pre,
+        [{"rlp": hex_(block.encode())}], block, post,
     )
 
 
 def gen_cancun_ops_fixture() -> dict:
     pre = _base_pre((CANCUN_OPS, CANCUN_OPS_CODE))
-    tx = _fee_tx(CANCUN_OPS)
-    genesis, block, state = _build_block(pre, [tx], b"\x11" * 32)
-    post = _dump_state(state)
+    genesis, block, state = _build(
+        pre, [_fee_tx(CANCUN_OPS)], beacon_root=b"\x11" * 32
+    )
+    post = dump_state(state)
     assert post[CANCUN_OPS].storage[1] == 42  # TSTORE/TLOAD
     assert post[CANCUN_OPS].storage[3] == int.from_bytes(
         bytes.fromhex(
@@ -334,72 +208,57 @@ def gen_cancun_ops_fixture() -> dict:
         "big",
     )  # MCOPY
     return _fixture(
-        "tstore_tload_mcopy",
-        pre,
-        [{"rlp": _hex(block.encode())}],
-        block,
-        post,
+        "tstore_tload_mcopy", pre,
+        [{"rlp": hex_(block.encode())}], block, post,
     )
 
 
 def gen_point_evaluation_fixture() -> dict:
     pre = _base_pre((POINT_EVAL, POINT_EVAL_CODE))
     data = _kzg_input()
-    tx = _fee_tx(POINT_EVAL, data=data, gas=400_000)
-    genesis, block, state = _build_block(pre, [tx], b"\x22" * 32)
-    post = _dump_state(state)
+    genesis, block, state = _build(
+        pre, [_fee_tx(POINT_EVAL, data=data, gas=400_000)],
+        beacon_root=b"\x22" * 32,
+    )
+    post = dump_state(state)
     assert post[POINT_EVAL].storage[0] == 1, "0x0A call must succeed"
     assert post[POINT_EVAL].storage[1] == 4096
     from phant_tpu.crypto import bls12_381 as bls
 
     assert post[POINT_EVAL].storage[2] == bls.R
     out = _fixture(
-        "point_evaluation_valid_proof",
-        pre,
-        [{"rlp": _hex(block.encode())}],
-        block,
-        post,
+        "point_evaluation_valid_proof", pre,
+        [{"rlp": hex_(block.encode())}], block, post,
     )
     # tampered y: the 0x0A call fails, the wrapper stores success=0 —
     # still a VALID block (precompile failure is an in-EVM event)
     bad = bytearray(data)
-    bad[95] ^= 1  # flip a bit of y
-    tx2 = _fee_tx(POINT_EVAL, data=bytes(bad), gas=400_000)
-    genesis2, block2, state2 = _build_block(pre, [tx2], b"\x22" * 32)
-    post2 = _dump_state(state2)
+    bad[95] ^= 1
+    genesis2, block2, state2 = _build(
+        pre, [_fee_tx(POINT_EVAL, data=bytes(bad), gas=400_000)],
+        beacon_root=b"\x22" * 32,
+    )
+    post2 = dump_state(state2)
     assert POINT_EVAL not in post2 or not post2[POINT_EVAL].storage.get(0)
     out.update(
         _fixture(
-            "point_evaluation_invalid_proof_reverting_call",
-            pre,
-            [{"rlp": _hex(block2.encode())}],
-            block2,
-            post2,
+            "point_evaluation_invalid_proof_reverting_call", pre,
+            [{"rlp": hex_(block2.encode())}], block2, post2,
         )
     )
     return out
 
 
 def main():
-    from phant_tpu.spec.fixtures import load_fixture_file
-    from phant_tpu.spec.runner import run_fixture
-
-    outdir = os.path.join("tests", "fixtures", "cancun")
-    os.makedirs(outdir, exist_ok=True)
-    files = {
-        "blob_txs.json": gen_blob_tx_fixtures(),
-        "beacon_root.json": gen_beacon_root_fixture(),
-        "cancun_opcodes.json": gen_cancun_ops_fixture(),
-        "point_evaluation.json": gen_point_evaluation_fixture(),
-    }
-    for fname, payload in files.items():
-        path = os.path.join(outdir, fname)
-        with open(path, "w") as f:
-            json.dump(payload, f, indent=1, sort_keys=True)
-        # self-check: every emitted fixture must pass the real runner
-        for fx in load_fixture_file(path):
-            run_fixture(fx)
-        print(f"wrote + verified {path} ({len(payload)} tests)")
+    write_and_verify(
+        os.path.join("tests", "fixtures", "cancun"),
+        {
+            "blob_txs.json": gen_blob_tx_fixtures(),
+            "beacon_root.json": gen_beacon_root_fixture(),
+            "cancun_opcodes.json": gen_cancun_ops_fixture(),
+            "point_evaluation.json": gen_point_evaluation_fixture(),
+        },
+    )
 
 
 if __name__ == "__main__":

@@ -1,0 +1,243 @@
+"""Generate execution-spec-style Prague blockchain fixtures.
+
+Same self-generated-oracle pattern as scripts/gen_cancun_fixtures.py
+(shared helpers in scripts/fixturegen.py): blocks built with the python
+EVM, real computed headers, every fixture re-verified through the
+stateful AND stateless runners before being written.
+
+Covers the Prague surface beyond the hand-written unit tests: a type-4
+(EIP-7702) set-code tx inside a full fixture block, the EIP-7685
+requests commitment (deposit log + 7002/7251 dequeues) end-to-end, an
+invalid requests_hash block, an EIP-2537 BLS precompile call from
+bytecode, and EIP-2935 ancestor-hash reads through the system contract.
+
+Usage: python scripts/gen_prague_fixtures.py  (writes tests/fixtures/prague/)
+"""
+
+import functools
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from fixturegen import (  # noqa: E402
+    build_block,
+    dump_state,
+    fee_tx,
+    fixture_entry,
+    hex_,
+    make_genesis,
+    write_and_verify,
+)
+
+from phant_tpu.blockchain import requests as req  # noqa: E402
+from phant_tpu.blockchain.fork import PragueFork  # noqa: E402
+from phant_tpu.crypto import secp256k1 as secp  # noqa: E402
+from phant_tpu.signer.signer import (  # noqa: E402
+    TxSigner,
+    address_from_pubkey,
+    sign_authorization,
+)
+from phant_tpu.types.account import Account  # noqa: E402
+from phant_tpu.types.transaction import SetCodeTx  # noqa: E402
+
+CHAIN_ID = 1
+SENDER_KEY = 0x45A915E4D060149EB4365960E6A7A45F334393093061116B197E3240065FF2D8
+SENDER = address_from_pubkey(secp.pubkey_of(SENDER_KEY))
+AUTH_KEY = 0xB0B1CAFE
+AUTHORITY = address_from_pubkey(secp.pubkey_of(AUTH_KEY))
+GENESIS_TS = 0x11000000
+
+_build = functools.partial(
+    build_block, fork_cls=PragueFork, genesis_ts=GENESIS_TS,
+    beacon_root=b"\x66" * 32,
+)
+_fixture = functools.partial(
+    fixture_entry,
+    network="Prague",
+    genesis_ts=GENESIS_TS,
+    generator="scripts/gen_prague_fixtures.py",
+)
+_fee_tx = functools.partial(fee_tx, SENDER_KEY)
+
+
+def _addr(n: int) -> bytes:
+    return n.to_bytes(20, "big")
+
+
+EMPTY_QUEUE = bytes.fromhex("5f5ff3")  # PUSH0 PUSH0 RETURN
+
+
+def _base_pre(*contracts) -> dict:
+    pre = {
+        SENDER: Account(balance=10**20),
+        req.WITHDRAWAL_REQUEST_ADDRESS: Account(nonce=1, code=EMPTY_QUEUE),
+        req.CONSOLIDATION_REQUEST_ADDRESS: Account(nonce=1, code=EMPTY_QUEUE),
+    }
+    for addr, code in contracts:
+        pre[addr] = Account(nonce=1, code=code)
+    return pre
+
+
+# --- scenario contracts -----------------------------------------------------
+
+DELEGATE = _addr(0xDE1E)
+# delegate runtime: SSTORE(0, 0x77) in the executing account's context
+DELEGATE_CODE = bytes.fromhex("60775f5500")
+
+BLS_CALLER = _addr(0xB15)
+
+
+def _bls_caller_code() -> bytes:
+    """CALLDATACOPY the input, CALL 0x0B (G1ADD) with it, store success at
+    slot 0 and the first 32 bytes of the returned point at slot 1."""
+    return (
+        bytes.fromhex("6101005f5f37")
+        + bytes.fromhex("60806101006101005f5f600b620fffff")
+        + bytes.fromhex("f15f55")
+        + bytes.fromhex("61010051600155")
+        + b"\x00"
+    )
+
+
+HISTORY_READER = _addr(0x2935)
+
+
+def _history_reader_code() -> bytes:
+    """Read ancestor hash 0 via the EIP-2935 system contract: MSTORE(0, 0);
+    CALL(HISTORY, input=32B block number) -> store returned hash."""
+    from phant_tpu.blockchain.fork import HISTORY_STORAGE_ADDRESS
+
+    return (
+        bytes.fromhex("5f5f52")
+        + bytes.fromhex("6020602060205f5f73") + HISTORY_STORAGE_ADDRESS
+        + bytes.fromhex("620fffff")
+        + bytes.fromhex("f1600155")
+        + bytes.fromhex("602051600055")
+        + b"\x00"
+    )
+
+
+def gen_setcode_fixture() -> dict:
+    pre = _base_pre((DELEGATE, DELEGATE_CODE))
+    pre[AUTHORITY] = Account(balance=10**18)
+    auth = sign_authorization(CHAIN_ID, DELEGATE, 0, AUTH_KEY)
+    tx = TxSigner(CHAIN_ID).sign(
+        SetCodeTx(
+            chain_id_val=CHAIN_ID, nonce=0, max_priority_fee_per_gas=1,
+            max_fee_per_gas=1000, gas_limit=400_000, to=AUTHORITY, value=0,
+            data=b"", access_list=(), authorization_list=(auth,),
+            y_parity=0, r=0, s=0,
+        ),
+        SENDER_KEY,
+    )
+    genesis, block, state = _build(pre, [tx])
+    post = dump_state(state)
+    from phant_tpu.evm import gas as G
+
+    assert post[AUTHORITY].code == G.DELEGATION_PREFIX + DELEGATE
+    assert post[AUTHORITY].nonce == 1
+    assert post[AUTHORITY].storage[0] == 0x77  # delegate ran in its context
+    out = _fixture(
+        "setcode_tx_delegated_execution", pre,
+        [{"rlp": hex_(block.encode())}], block, post,
+    )
+    # the same block with a corrupted requests_hash must be rejected
+    genesis2, bad, _ = _build(pre, [tx], requests_hash_override=b"\x13" * 32)
+    out.update(
+        _fixture(
+            "requests_hash_mismatch", pre,
+            [{"rlp": hex_(bad.encode()),
+              "expectException": "requests hash mismatch"}],
+            make_genesis(pre, GENESIS_TS), pre,
+        )
+    )
+    return out
+
+
+def gen_deposit_fixture() -> dict:
+    # deposit contract that re-emits calldata as a DepositEvent (same mock
+    # as tests/test_requests.py)
+    logger = (
+        bytes.fromhex("6102406000600037")
+        + b"\x7f" + req.DEPOSIT_EVENT_SIGNATURE_HASH
+        + bytes.fromhex("6102406000a100")
+    )
+    pre = _base_pre((req.DEPOSIT_CONTRACT_ADDRESS, logger))
+
+    def word(n):
+        return n.to_bytes(32, "big")
+
+    def tail(payload):
+        return word(len(payload)) + payload + bytes(-len(payload) % 32)
+
+    event = (
+        word(160) + word(256) + word(320) + word(384) + word(512)
+        + tail(b"\x0a" * 48) + tail(b"\x0b" * 32) + tail(b"\x0c" * 8)
+        + tail(b"\x0d" * 96) + tail(b"\x0e" * 8)
+    )
+    genesis, block, state = _build(
+        pre, [_fee_tx(req.DEPOSIT_CONTRACT_ADDRESS, data=event)]
+    )
+    post = dump_state(state)
+    expect = req.compute_requests_hash(
+        [req.DEPOSIT_REQUEST_TYPE
+         + (b"\x0a" * 48 + b"\x0b" * 32 + b"\x0c" * 8 + b"\x0d" * 96 + b"\x0e" * 8)]
+    )
+    assert block.header.requests_hash == expect
+    return _fixture(
+        "deposit_log_to_requests_hash", pre,
+        [{"rlp": hex_(block.encode())}], block, post,
+    )
+
+
+def gen_bls_precompile_fixture() -> dict:
+    from phant_tpu.crypto import bls12_381 as bls
+    from phant_tpu.evm import precompiles_bls as pb
+
+    pre = _base_pre((BLS_CALLER, _bls_caller_code()))
+    g = bls.G1_GEN
+    g2 = bls.g1_mul(g, 2)
+    data = pb._write_g1(g) + pb._write_g1(g2)  # G1ADD(G, 2G) = 3G
+    genesis, block, state = _build(pre, [_fee_tx(BLS_CALLER, data=data)])
+    post = dump_state(state)
+    g3 = bls.g1_mul(g, 3)
+    assert post[BLS_CALLER].storage[0] == 1
+    assert post[BLS_CALLER].storage[1] == int.from_bytes(
+        pb._write_fp(g3[0])[:32], "big"
+    )
+    return _fixture(
+        "bls12_g1add_precompile", pre,
+        [{"rlp": hex_(block.encode())}], block, post,
+    )
+
+
+def gen_history_fixture() -> dict:
+    pre = _base_pre((HISTORY_READER, _history_reader_code()))
+    genesis, block, state = _build(pre, [_fee_tx(HISTORY_READER)])
+    post = dump_state(state)
+    assert post[HISTORY_READER].storage[1] == 1
+    assert post[HISTORY_READER].storage[0] == int.from_bytes(
+        genesis.header.hash(), "big"
+    )
+    return _fixture(
+        "eip2935_history_contract_read", pre,
+        [{"rlp": hex_(block.encode())}], block, post,
+    )
+
+
+def main():
+    write_and_verify(
+        os.path.join("tests", "fixtures", "prague"),
+        {
+            "setcode_txs.json": gen_setcode_fixture(),
+            "deposit_requests.json": gen_deposit_fixture(),
+            "bls_precompiles.json": gen_bls_precompile_fixture(),
+            "history_contract.json": gen_history_fixture(),
+        },
+    )
+
+
+if __name__ == "__main__":
+    main()
