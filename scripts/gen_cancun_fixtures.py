@@ -38,7 +38,6 @@ from fixturegen import (  # noqa: E402
 from phant_tpu.blockchain.fork import BEACON_ROOTS_ADDRESS, CancunFork  # noqa: E402
 from phant_tpu.crypto import secp256k1 as secp  # noqa: E402
 from phant_tpu.signer.signer import TxSigner, address_from_pubkey  # noqa: E402
-from phant_tpu.state.statedb import StateDB  # noqa: E402  (re-export path)
 from phant_tpu.types.account import Account  # noqa: E402
 from phant_tpu.types.block import Block  # noqa: E402
 from phant_tpu.types.transaction import BlobTx  # noqa: E402
@@ -164,7 +163,7 @@ def gen_blob_tx_fixtures() -> dict:
 
     out = _fixture(
         "blob_tx_blobhash_blobbasefee", pre,
-        [{"rlp": hex_(block.encode())}], block, post,
+        [{"rlp": hex_(block.encode())}], block, post, genesis=genesis,
     )
     # the same block with a LYING blobGasUsed header must be rejected
     bad_header = drep(block.header, blob_gas_used=131072)
@@ -190,7 +189,7 @@ def gen_beacon_root_fixture() -> dict:
     assert post[BEACON_READ].storage[1] == 1
     return _fixture(
         "beacon_root_contract_readback", pre,
-        [{"rlp": hex_(block.encode())}], block, post,
+        [{"rlp": hex_(block.encode())}], block, post, genesis=genesis,
     )
 
 
@@ -209,7 +208,7 @@ def gen_cancun_ops_fixture() -> dict:
     )  # MCOPY
     return _fixture(
         "tstore_tload_mcopy", pre,
-        [{"rlp": hex_(block.encode())}], block, post,
+        [{"rlp": hex_(block.encode())}], block, post, genesis=genesis,
     )
 
 
@@ -228,7 +227,7 @@ def gen_point_evaluation_fixture() -> dict:
     assert post[POINT_EVAL].storage[2] == bls.R
     out = _fixture(
         "point_evaluation_valid_proof", pre,
-        [{"rlp": hex_(block.encode())}], block, post,
+        [{"rlp": hex_(block.encode())}], block, post, genesis=genesis,
     )
     # tampered y: the 0x0A call fails, the wrapper stores success=0 —
     # still a VALID block (precompile failure is an in-EVM event)
@@ -243,7 +242,7 @@ def gen_point_evaluation_fixture() -> dict:
     out.update(
         _fixture(
             "point_evaluation_invalid_proof_reverting_call", pre,
-            [{"rlp": hex_(block2.encode())}], block2, post2,
+            [{"rlp": hex_(block2.encode())}], block2, post2, genesis=genesis2,
         )
     )
     return out

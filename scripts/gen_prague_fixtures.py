@@ -141,7 +141,7 @@ def gen_setcode_fixture() -> dict:
     assert post[AUTHORITY].storage[0] == 0x77  # delegate ran in its context
     out = _fixture(
         "setcode_tx_delegated_execution", pre,
-        [{"rlp": hex_(block.encode())}], block, post,
+        [{"rlp": hex_(block.encode())}], block, post, genesis=genesis,
     )
     # the same block with a corrupted requests_hash must be rejected
     genesis2, bad, _ = _build(pre, [tx], requests_hash_override=b"\x13" * 32)
@@ -188,7 +188,7 @@ def gen_deposit_fixture() -> dict:
     assert block.header.requests_hash == expect
     return _fixture(
         "deposit_log_to_requests_hash", pre,
-        [{"rlp": hex_(block.encode())}], block, post,
+        [{"rlp": hex_(block.encode())}], block, post, genesis=genesis,
     )
 
 
@@ -209,7 +209,7 @@ def gen_bls_precompile_fixture() -> dict:
     )
     return _fixture(
         "bls12_g1add_precompile", pre,
-        [{"rlp": hex_(block.encode())}], block, post,
+        [{"rlp": hex_(block.encode())}], block, post, genesis=genesis,
     )
 
 
@@ -223,7 +223,7 @@ def gen_history_fixture() -> dict:
     )
     return _fixture(
         "eip2935_history_contract_read", pre,
-        [{"rlp": hex_(block.encode())}], block, post,
+        [{"rlp": hex_(block.encode())}], block, post, genesis=genesis,
     )
 
 

@@ -369,3 +369,68 @@ def test_newpayload_v3_cancun_roundtrip():
     _http, body2 = handle_request(chain2, req_bad)
     assert body2["result"]["status"] == "INVALID"
     assert "blob versioned hashes" in body2["result"]["validationError"]
+
+
+def test_newpayload_v4_executionrequests_validation():
+    """engine_newPayloadV4: the executionRequests side channel must be
+    strictly type-ascending with non-empty data, and its hash folds into
+    the header before the V3/V2 path runs (a mismatched blockHash proves
+    the fold happened — the same payload bytes hash differently once
+    requests_hash is set)."""
+    chain = _fresh_chain()
+    params = _valid_payload_json()
+    params["blobGasUsed"] = "0x0"
+    params["excessBlobGas"] = "0x0"
+    beacon = bytes_to_hex(b"\x5b" * 32)
+    base = {"jsonrpc": "2.0", "id": 11, "method": "engine_newPayloadV4"}
+
+    # misordered types
+    req = {**base, "params": [params, [], beacon, ["0x01aa", "0x00bb"]]}
+    _http, body = handle_request(chain, req)
+    assert body["result"]["status"] == "INVALID"
+    assert "type-ascending" in body["result"]["validationError"]
+
+    # an item with no data after the type byte
+    req = {**base, "params": [params, [], beacon, ["0x00"]]}
+    _http, body = handle_request(chain, req)
+    assert body["result"]["status"] == "INVALID"
+    assert "without data" in body["result"]["validationError"]
+
+    # well-formed requests fold their hash into the header: with the
+    # payload's blockHash computed WITHOUT requests_hash (as a CL would
+    # over these bytes), the fold — and only the fold — makes it mismatch
+    params = _with_real_block_hash(params)
+    req = {**base, "params": [params, [], beacon, ["0x00aa", "0x01bb"]]}
+    _http, body = handle_request(chain, req)
+    assert body["result"]["status"] == "INVALID"
+    assert "blockHash mismatch" in body["result"]["validationError"]
+
+
+def test_consensus_data_unavailable_propagates(evm_backend_cpu):
+    """A Prague block calling the gated map-to-curve precompile must abort
+    validation loudly (not fake a post-state) on BOTH EVM backends — on
+    the native backend the exception crosses the C frame via the error
+    stash (native_vm.py)."""
+    from phant_tpu.evm.interpreter import Evm
+    from phant_tpu.evm.message import (
+        REVISION_PRAGUE,
+        Environment,
+        Message,
+    )
+    from phant_tpu.evm.precompiles_bls import ConsensusDataUnavailable
+    from phant_tpu.state.statedb import StateDB
+
+    # caller bytecode: CALL(gas, 0x10, 0, 0, 64, 0, 0); STOP
+    code = bytes.fromhex("5f5f60405f5f601062030d40f100")
+    caller = b"\xca" * 20
+    state = StateDB()
+    state.create_account(caller)
+    state.set_code(caller, code)
+    env = Environment(state=state, revision=REVISION_PRAGUE)
+    evm = Evm(env)
+    state.start_tx()
+    with pytest.raises(ConsensusDataUnavailable):
+        evm.execute_message(
+            Message(caller=b"\x11" * 20, target=caller, value=0,
+                    data=b"", gas=5_000_000)
+        )
