@@ -10,10 +10,12 @@ check-fast:
 	PHANT_CHECK_DEVICE=0 ./scripts/check.sh -x
 
 # Only the device-kernel files (CI runs this in parallel with check-fast).
+# Keep in sync with scripts/check.sh DEVICE_GROUPS.
 check-device:
 	python -m pytest tests/test_secp256k1_jax.py tests/test_secp256k1_glv.py \
-	  tests/test_keccak_jax.py tests/test_witness_jax.py \
-	  tests/test_witness_fused.py tests/test_mpt_jax.py tests/test_parallel.py -q
+	  tests/test_keccak_jax.py tests/test_keccak_pallas.py \
+	  tests/test_witness_jax.py tests/test_witness_fused.py \
+	  tests/test_mpt_jax.py tests/test_parallel.py tests/test_graft_entry.py -q
 
 native:
 	python -c "from phant_tpu.utils.native import build_native; print(build_native(verbose=True))"
