@@ -1,5 +1,5 @@
 # Commit gate (VERDICT r2 #4): `make check` must be green before a snapshot.
-.PHONY: check check-fast check-device native sanitize
+.PHONY: check check-fast check-device native sanitize metrics-lint
 
 check:
 	./scripts/check.sh
@@ -29,3 +29,9 @@ sanitize:
 	  native/keccak.cc native/packer.cc native/secp256k1.cc native/engine.cc \
 	  native/selftest.cc
 	./build/native_selftest
+
+# Metric-name drift gate: smoke-verify a witness + Engine API round trip,
+# then assert every exported family is phant_[a-z0-9_]+ with a help string
+# (trace.METRIC_HELP). Keep in sync with README "Observability".
+metrics-lint:
+	JAX_PLATFORMS=cpu python scripts/metrics_lint.py

@@ -66,6 +66,34 @@ and the keccak microbench (config #2).
 Platform selection is loud: a broken tunnel degrades to CPU only with
 detail.tpu_expected_but_absent set (PHANT_BENCH_REQUIRE_TPU=1 hard-fails
 instead) — a dead tunnel must never masquerade as a CPU baseline.
+
+PHASE ATTRIBUTION (detail.metrics): the process metrics registry
+(phant_tpu/utils/trace.py) is RESET before each section and snapshotted
+after it, so every artifact carries per-section phase attribution instead
+of a bare throughput number. Schema:
+
+    detail.metrics = {
+      "<section>": {                # CPU sections: "engine", "keccak", ...;
+                                    # device children: "<section>_device";
+                                    # inline device: "<section>_device_inline"
+        "counters":   {name[{labels}]: int, ...},
+        "gauges":     {name[{labels}]: float, ...},
+        "histograms": {name: {"buckets": [...], "counts": [...],
+                              "sum": float, "count": int}, ...},
+        "timers":     {name: {"count", "total_s", "mean_s",
+                              "min_s", "max_s"}, ...},
+      }, ...
+    }
+
+The engine section's timers carry the hash-vs-intern-vs-linkage-join
+split of WitnessEngine.verify_batch (witness_engine.hash /
+witness_engine.intern / witness_engine.linkage_join) plus the
+keccak.device_dispatch / keccak.host_readback transfer split on device
+runs — the attribution benchmarking-oriented related work uses to locate
+the hashing bottleneck. Device-child sections embed their snapshot in
+their fragment line under the distinct `<section>_device` key; the parent
+deep-merges the `metrics` key, so the CPU and device runs of one section
+never clobber each other's attribution.
 """
 
 from __future__ import annotations
@@ -1355,12 +1383,40 @@ def _replay(backend: str, verify_root: bool) -> dict:
     return out
 
 
+def _merge_frag(detail: dict, frag: dict) -> None:
+    """detail.update(frag), except the per-section `metrics` snapshots
+    deep-merge (each section contributes its own key under
+    detail.metrics; a flat update would clobber earlier sections)."""
+    m = frag.get("metrics")
+    if m:
+        frag = {k: v for k, v in frag.items() if k != "metrics"}
+        detail.setdefault("metrics", {}).update(m)
+    detail.update(frag)
+
+
+def _metrics_reset() -> None:
+    from phant_tpu.utils.trace import metrics
+
+    metrics.reset()
+
+
+def _metrics_frag(section: str) -> dict:
+    """{"metrics": {section: snapshot}} for a just-finished section, or {}
+    when the section recorded nothing (keeps artifacts lean)."""
+    from phant_tpu.utils.trace import metrics
+
+    snap = metrics.snapshot()
+    if not any(snap.values()):
+        return {}
+    return {"metrics": {section: snap}}
+
+
 def _bank(frag: dict) -> None:
     """Make a finished measurement durable immediately: into _PARTIAL in
     the parent (the global deadline prints it), onto stdout as a fragment
     line in a device child (the parent merges EVERY fragment line, so a
     later SIGKILL costs only the unfinished work — r3 #2's fix)."""
-    _PARTIAL["detail"].update(frag)
+    _merge_frag(_PARTIAL["detail"], frag)
     if _IS_CHILD:
         print(_FRAGMENT_MARK + json.dumps(frag), flush=True)
 
@@ -1425,10 +1481,17 @@ def _child_main(name: str) -> None:
     from phant_tpu.utils.jaxcache import enable_compile_cache
 
     enable_compile_cache()
+    _metrics_reset()
     try:
         frag = _DEVICE_SECTIONS[name]()
     except Exception as e:
         frag = {f"{name}_device_error": repr(e)[:240]}
+    # per-section phase attribution rides in the same fragment line (a
+    # kill after the section loses only this snapshot, not measurements);
+    # keyed `<name>_device` so the CPU section of the same name can never
+    # clobber the device attribution in detail.metrics (or vice versa on
+    # the late tunnel-revival path)
+    frag.update(_metrics_frag(f"{name}_device"))
     print(_FRAGMENT_MARK + json.dumps(frag), flush=True)
 
 
@@ -1617,7 +1680,7 @@ def main() -> None:
                 continue
             device_env["PHANT_BENCH_DEVICE"] = "1"
             frag = _spawn_section(name, budget, device_env)
-            detail.update(frag)
+            _merge_frag(detail, frag)
             device_done.add(name)
 
     def run_cpu_sections() -> None:
@@ -1626,13 +1689,17 @@ def main() -> None:
                 continue
             _log(f"cpu section {name} ...")
             t0 = time.perf_counter()
+            _metrics_reset()
             try:
                 with _watchdog(
                     int(os.environ.get("PHANT_BENCH_SECTION_TIMEOUT", "480"))
                 ):
-                    detail.update(fn())
+                    _merge_frag(detail, fn())
             except Exception as e:
                 detail[f"{name}_cpu_error"] = repr(e)[:200]
+            # snapshot whatever the section recorded (even on a timeout —
+            # partial phase attribution still explains the artifact)
+            _merge_frag(detail, _metrics_frag(name))
             _log(f"cpu section {name} done in {time.perf_counter() - t0:.1f}s")
             _refresh_headline()
 
@@ -1650,22 +1717,26 @@ def main() -> None:
                 continue
             _log(f"inline device section {name} ...")
             t0 = time.perf_counter()
+            _metrics_reset()
             try:
                 with _watchdog():
-                    detail.update(_DEVICE_SECTIONS[name]())
+                    _merge_frag(detail, _DEVICE_SECTIONS[name]())
             except Exception as e:
                 detail[f"{name}_device_error"] = repr(e)[:200]
+            _merge_frag(detail, _metrics_frag(f"{name}_device_inline"))
             _log(f"inline device section {name} done in {time.perf_counter() - t0:.1f}s")
         if "ecrecover" in selected and os.environ.get(
             "PHANT_BENCH_ECRECOVER", "1"
         ) not in ("0", ""):
+            _metrics_reset()
             try:
                 with _watchdog(
                     int(os.environ.get("PHANT_BENCH_ECRECOVER_TIMEOUT", "900"))
                 ):
-                    detail.update(sec_ecrecover_device())
+                    _merge_frag(detail, sec_ecrecover_device())
             except Exception as e:
                 detail["ecrecover_device_error"] = repr(e)[:200]
+            _merge_frag(detail, _metrics_frag("ecrecover_device_inline"))
 
     def _refresh_headline() -> None:
         cpu_rate = detail.get("cpu_baseline_blocks_per_sec")

@@ -22,6 +22,7 @@ from phant_tpu.config import ChainConfig, ChainId
 from phant_tpu.engine_api.server import EngineAPIServer
 from phant_tpu.state.statedb import StateDB
 from phant_tpu.types.block import BlockHeader
+from phant_tpu.utils.trace import jax_profile
 from phant_tpu.version import RELEASE, revision
 
 log = logging.getLogger("phant_tpu")
@@ -64,6 +65,27 @@ def build_parser() -> argparse.ArgumentParser:
     )
     # the Engine API is a localhost-trust interface; bind loopback by default
     p.add_argument("--host", type=str, default="127.0.0.1", help="Bind address")
+    # observability surface (the Engine API port always serves GET /metrics
+    # and /healthz; these flags add a standalone scrape port + device traces)
+    p.add_argument(
+        "--metrics",
+        action="store_true",
+        help="Also serve GET /metrics and /healthz on a dedicated port "
+        "(--metrics-port), separate from the CL-trust Engine API port",
+    )
+    p.add_argument(
+        "--metrics-port",
+        type=int,
+        default=9465,
+        help="Port for the standalone metrics server (with --metrics)",
+    )
+    p.add_argument(
+        "--trace-logdir",
+        type=str,
+        default=None,
+        help="Capture a JAX/XLA device trace of the serving process into "
+        "this directory (view with TensorBoard or Perfetto)",
+    )
     return p
 
 
@@ -110,10 +132,21 @@ def main(argv=None) -> int:
 
     server = EngineAPIServer(chain, host=args.host, port=args.engine_api_port)
     log.info("Engine API listening on %s:%d", args.host, server.port)
+    metrics_server = None
+    if args.metrics:
+        from phant_tpu.engine_api.server import serve_metrics
+
+        metrics_server = serve_metrics(host=args.host, port=args.metrics_port)
     try:
-        server.serve_forever()
+        # --trace-logdir wraps the whole serving run in the JAX profiler
+        # (no-op without the flag) so TPU kernel dispatches of served
+        # payloads land in a TensorBoard/Perfetto trace
+        with jax_profile(args.trace_logdir):
+            server.serve_forever()
     except KeyboardInterrupt:
         server.shutdown()
+        if metrics_server is not None:
+            metrics_server.shutdown()
     return 0
 
 

@@ -23,6 +23,9 @@ def set_crypto_backend(name: str) -> None:
     global _CRYPTO_BACKEND
     if name not in _VALID:
         raise ValueError(f"crypto backend must be one of {_VALID}, got {name!r}")
+    from phant_tpu.utils.trace import metrics
+
+    metrics.count("backend.selected", backend=name)
     _CRYPTO_BACKEND = name
 
 
@@ -216,12 +219,21 @@ def device_offload_pays(nbytes: int) -> bool:
     """Shared offload gate for byte-dense hashing work (witness novel-node
     batches, trie-root plans): ship only if upload + round trip + device
     hash beats hashing the same bytes natively on the host. Callers must
-    check the crypto backend BEFORE calling — this probes the device link."""
+    check the crypto backend BEFORE calling — this probes the device link.
+    Every verdict counts into `backend.offload_decisions{route=...}` so the
+    gate's behavior is auditable from /metrics."""
+    from phant_tpu.utils.trace import metrics
+
     if not device_offload_possible():
         # no link speed can make the inequality hold; skip the probe
+        metrics.count("backend.offload_decisions", route="native")
         return False
     up_bps, rtt = device_link_profile()
-    return nbytes / up_bps + rtt + nbytes / device_hash_bps() < nbytes / NATIVE_HASH_BPS
+    pays = (
+        nbytes / up_bps + rtt + nbytes / device_hash_bps() < nbytes / NATIVE_HASH_BPS
+    )
+    metrics.count("backend.offload_decisions", route="device" if pays else "native")
+    return pays
 
 
 def set_evm_backend(name: str) -> None:

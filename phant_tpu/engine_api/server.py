@@ -5,18 +5,81 @@ src/main.zig:143-149: POST / routed to engineAPIHandler with the
 *Blockchain as per-request context). Uses the stdlib ThreadingHTTPServer —
 the handler holds a lock around block execution because `Blockchain`
 mutates shared state (the reference is effectively serial there too).
-"""
+
+Observability surface: `GET /metrics` serves the process metrics registry
+as Prometheus text exposition, `GET /healthz` a JSON liveness probe;
+every POST is counted, latency-histogrammed, and gauge-tracked in flight
+(phant_tpu/utils/trace.py). `serve_metrics()` runs the same two GET
+endpoints standalone for `--metrics-port` deployments where the Engine API
+port is CL-only."""
 
 from __future__ import annotations
 
 import json
 import logging
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from phant_tpu.engine_api import handle_request
+from phant_tpu.utils.trace import metrics
 
 log = logging.getLogger("phant_tpu.engine_api")
+
+_START_MONOTONIC = time.monotonic()
+
+
+def _healthz_payload() -> dict:
+    from phant_tpu.version import RELEASE, revision
+
+    return {
+        "status": "ok",
+        "version": RELEASE,
+        "revision": revision(),
+        "uptime_s": round(time.monotonic() - _START_MONOTONIC, 1),
+    }
+
+
+class _ObservableHandler(BaseHTTPRequestHandler):
+    """Shared GET surface + disconnect-tolerant reply plumbing."""
+
+    protocol_version = "HTTP/1.1"
+
+    def do_GET(self) -> None:  # noqa: N802 (stdlib API)
+        path = self.path.split("?", 1)[0]
+        if path == "/metrics":
+            self._reply_raw(
+                200,
+                metrics.prometheus_text().encode(),
+                "text/plain; version=0.0.4; charset=utf-8",
+            )
+        elif path == "/healthz":
+            self._reply(200, _healthz_payload())
+        else:
+            self._reply(404, {"error": "not found"})
+
+    def _reply(self, status: int, payload: dict) -> None:
+        self._reply_raw(status, json.dumps(payload).encode(), "application/json")
+
+    def _reply_raw(self, status: int, raw: bytes, content_type: str) -> None:
+        # a client that hangs up mid-response (CL restart, curl ^C) raises
+        # here and would otherwise kill the handler thread silently — count
+        # it and keep serving
+        try:
+            self.send_response(status)
+            self.send_header("Content-Type", content_type)
+            self.send_header("Content-Length", str(len(raw)))
+            self.end_headers()
+            self.wfile.write(raw)
+        except (BrokenPipeError, ConnectionResetError) as e:
+            metrics.count("engine_api.client_disconnects")
+            log.debug("client disconnected mid-reply: %r", e)
+            # stop the keep-alive loop: reading the dead socket again would
+            # raise out of handle_one_request and traceback to stderr
+            self.close_connection = True
+
+    def log_message(self, fmt, *args):  # route to logging, not stderr
+        log.debug(fmt, *args)
 
 
 class EngineAPIServer:
@@ -27,19 +90,30 @@ class EngineAPIServer:
         self._lock = threading.Lock()
         outer = self
 
-        class Handler(BaseHTTPRequestHandler):
-            protocol_version = "HTTP/1.1"
-
+        class Handler(_ObservableHandler):
             def do_POST(self) -> None:  # noqa: N802 (stdlib API)
+                t0 = time.perf_counter()
+                metrics.gauge_add("engine_api.inflight", 1)
+                try:
+                    self._handle_post()
+                finally:
+                    metrics.gauge_add("engine_api.inflight", -1)
+                    metrics.observe_hist(
+                        "engine_api.request_seconds", time.perf_counter() - t0
+                    )
+
+            def _handle_post(self) -> None:
                 length = int(self.headers.get("Content-Length", 0))
                 body = self.rfile.read(length)
                 try:
                     request = json.loads(body)
                 except json.JSONDecodeError:
+                    metrics.count("engine_api.request_errors")
                     self._reply(400, {"error": {"code": -32700, "message": "parse error"}})
                     return
                 if not isinstance(request, dict):
                     # batch requests and non-object bodies are not supported
+                    metrics.count("engine_api.request_errors")
                     self._reply(
                         400,
                         {
@@ -51,18 +125,9 @@ class EngineAPIServer:
                     return
                 with outer._lock:
                     status, response = handle_request(outer.blockchain, request)
+                if status >= 400 or "error" in response:
+                    metrics.count("engine_api.request_errors")
                 self._reply(status, response)
-
-            def _reply(self, status: int, payload: dict) -> None:
-                raw = json.dumps(payload).encode()
-                self.send_response(status)
-                self.send_header("Content-Type", "application/json")
-                self.send_header("Content-Length", str(len(raw)))
-                self.end_headers()
-                self.wfile.write(raw)
-
-            def log_message(self, fmt, *args):  # route to logging, not stderr
-                log.debug(fmt, *args)
 
         self._server = ThreadingHTTPServer((host, port), Handler)
 
@@ -82,3 +147,33 @@ class EngineAPIServer:
     def shutdown(self) -> None:
         self._server.shutdown()
         self._server.server_close()
+
+
+class MetricsServer:
+    """Standalone `/metrics` + `/healthz` server (`--metrics-port`): the
+    Engine API port is a localhost CL-trust interface, while scrapers may
+    live elsewhere — a separate bind keeps the two audiences separable."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 9465):
+        self._server = ThreadingHTTPServer((host, port), _ObservableHandler)
+
+    @property
+    def port(self) -> int:
+        return self._server.server_address[1]
+
+    def serve_in_background(self) -> threading.Thread:
+        t = threading.Thread(target=self._server.serve_forever, daemon=True)
+        t.start()
+        return t
+
+    def shutdown(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+
+
+def serve_metrics(host: str = "127.0.0.1", port: int = 9465) -> MetricsServer:
+    """Start the standalone metrics server in a daemon thread."""
+    srv = MetricsServer(host, port)
+    srv.serve_in_background()
+    log.info("metrics listening on %s:%d", host, srv.port)
+    return srv

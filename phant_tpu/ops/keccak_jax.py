@@ -225,9 +225,20 @@ def digests_to_bytes(digests: np.ndarray) -> List[bytes]:
 def keccak256_batch_jax(payloads: Sequence[bytes], max_chunks: int | None = None) -> List[bytes]:
     """Convenience end-to-end helper (host pack -> device hash -> bytes).
 
-    Dispatches through keccak256_chunked_auto (Pallas on real TPUs)."""
+    Dispatches through keccak256_chunked_auto (Pallas on real TPUs).
+    Counts batches/bytes per device platform and splits the upload+dispatch
+    timer from the forced-readback timer in the metrics registry."""
     if not payloads:
         return []
+    from phant_tpu.utils.trace import metrics
+
+    platform = jax.default_backend()
+    metrics.count("keccak.batches", backend=platform)
+    metrics.count("keccak.bytes", sum(map(len, payloads)), backend=platform)
     words, nchunks, C = pack_payloads(payloads, max_chunks)
-    out = keccak256_chunked_auto(jnp.asarray(words), jnp.asarray(nchunks), max_chunks=C)
-    return digests_to_bytes(np.asarray(out))
+    with metrics.phase("keccak.device_dispatch"):
+        out = keccak256_chunked_auto(
+            jnp.asarray(words), jnp.asarray(nchunks), max_chunks=C
+        )
+    with metrics.phase("keccak.host_readback"):
+        return digests_to_bytes(np.asarray(out))

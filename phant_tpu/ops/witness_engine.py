@@ -42,6 +42,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from phant_tpu.utils.trace import metrics
 from phant_tpu.ops.witness_jax import (
     WITNESS_MAX_CHUNKS,
     _account_storage_root_off,
@@ -138,6 +139,12 @@ class WitnessEngine:
     def _hash_batch(
         self, nodes: List[bytes], route_device: Optional[bool] = None
     ) -> List[bytes]:
+        with metrics.phase("witness_engine.hash"):
+            return self._hash_batch_routed(nodes, route_device)
+
+    def _hash_batch_routed(
+        self, nodes: List[bytes], route_device: Optional[bool] = None
+    ) -> List[bytes]:
         if self._hasher is not None:
             return list(self._hasher(nodes))
         if route_device is None:
@@ -214,30 +221,35 @@ class WitnessEngine:
             )
         else:
             use_sharded = sharded == "1"
-        if use_sharded and len(jax.devices()) > 1 and B % len(jax.devices()) == 0:
-            # multi-chip novelty hashing: shard the node axis over the
-            # mesh (default-safe: the sharded compile's cache-suspension
-            # window is lock-serialized, see parallel/mesh.py)
-            from phant_tpu.parallel.mesh import (
-                make_mesh,
-                witness_digests_sharded,
-            )
+        # dispatch (upload + kernel launch) vs readback (the honest sync)
+        # timed separately: on a tunneled chip the split localizes whether
+        # the link or the kernel is eating the batch budget
+        with metrics.phase("keccak.device_dispatch"):
+            if use_sharded and len(jax.devices()) > 1 and B % len(jax.devices()) == 0:
+                # multi-chip novelty hashing: shard the node axis over the
+                # mesh (default-safe: the sharded compile's cache-suspension
+                # window is lock-serialized, see parallel/mesh.py)
+                from phant_tpu.parallel.mesh import (
+                    make_mesh,
+                    witness_digests_sharded,
+                )
 
-            out = witness_digests_sharded(
-                make_mesh(),
-                blob,
-                offsets,
-                lens,
-                max_chunks=WITNESS_MAX_CHUNKS,
-            )
-        else:
-            out = witness_digests(
-                jnp.asarray(blob),
-                jnp.asarray(offsets),
-                jnp.asarray(lens),
-                max_chunks=WITNESS_MAX_CHUNKS,
-            )
-        return digests_to_bytes(np.asarray(out))[: len(nodes)]
+                out = witness_digests_sharded(
+                    make_mesh(),
+                    blob,
+                    offsets,
+                    lens,
+                    max_chunks=WITNESS_MAX_CHUNKS,
+                )
+            else:
+                out = witness_digests(
+                    jnp.asarray(blob),
+                    jnp.asarray(offsets),
+                    jnp.asarray(lens),
+                    max_chunks=WITNESS_MAX_CHUNKS,
+                )
+        with metrics.phase("keccak.host_readback"):
+            return digests_to_bytes(np.asarray(out))[: len(nodes)]
 
     @staticmethod
     def _pack_blob(nodes: Sequence[bytes]):
@@ -349,6 +361,9 @@ class WitnessEngine:
             digests = self._hash_batch(novel)
             ref_digests, ref_node = self._refs_for_batch(novel)
             self.stats["hashed"] += len(novel)
+            self.stats["novel_bytes"] = self.stats.get("novel_bytes", 0) + sum(
+                map(len, novel)
+            )
             base_row = self._n_rows
             self._n_rows += len(novel)
             self._grow(self._n_rows)
@@ -418,20 +433,53 @@ class WitnessEngine:
         Block b verifies iff some node's digest equals root_b AND every node
         is that root or is hash-referenced by another node of block b
         (exactly witness_verify_fused's semantics; references are acyclic
-        because a cycle would be a keccak collision)."""
-        with self._lock:
-            if self._ext_core is not None:
-                return self._verify_ext(witnesses)
+        because a cycle would be a keccak collision).
+
+        Instrumented at BATCH granularity (per-node bookkeeping would be
+        measurable overhead on the hot path): cache hit/miss/eviction and
+        novel-bytes counters from the stats delta, interned-set gauges, and
+        the hash / intern / linkage-join phase split in the registry. The
+        delta is captured under the engine lock so concurrent callers can
+        never double-count each other's work; the registry publish happens
+        after release (the metrics lock never nests inside ours)."""
+        with metrics.phase("witness_engine.verify_batch"):
+            with self._lock:
+                s0 = dict(self.stats)
+                verdict = self._verify_batch_locked(witnesses)
+                s1 = self.stats
+                deltas = [
+                    (metric, s1.get(stat_key, 0) - s0.get(stat_key, 0))
+                    for stat_key, metric in (
+                        ("hits", "witness_engine.cache_hits"),
+                        ("hashed", "witness_engine.cache_misses"),
+                        ("evictions", "witness_engine.evictions"),
+                        ("novel_bytes", "witness_engine.novel_bytes_hashed"),
+                    )
+                ]
+                snap = self._stats_snapshot_locked()
+        for metric, d in deltas:
+            if d:
+                metrics.count(metric, d)
+        metrics.gauge_set("witness_engine.interned_nodes", snap["interned_nodes"])
+        metrics.gauge_set(
+            "witness_engine.interned_digests", snap["interned_digests"]
+        )
+        return verdict
+
+    def _verify_batch_locked(
+        self, witnesses: Sequence[Tuple[bytes, Sequence[bytes]]]
+    ) -> np.ndarray:
+        if self._ext_core is not None:
+            return self._verify_ext(witnesses)
         n_blocks = len(witnesses)
         all_nodes: List[bytes] = []
         counts = np.empty(n_blocks, np.int64)
         for b, (_root, nodes) in enumerate(witnesses):
             counts[b] = len(nodes)
             all_nodes.extend(nodes)
-        with self._lock:
-            if self._core is not None:
-                return self._verify_native(witnesses, all_nodes, counts, n_blocks)
-            return self._verify_interned(witnesses, all_nodes, counts, n_blocks)
+        if self._core is not None:
+            return self._verify_native(witnesses, all_nodes, counts, n_blocks)
+        return self._verify_interned(witnesses, all_nodes, counts, n_blocks)
 
     def _verify_ext(self, witnesses):
         """Two-call scan/finish protocol against the CPython extension
@@ -442,16 +490,21 @@ class WitnessEngine:
         otherwise the novel list comes back here so the backend route
         applies identically to every core."""
         st = self._ext_core
-        novel, miss, total = st.scan(witnesses)
+        with metrics.phase("witness_engine.intern"):
+            novel, miss, total = st.scan(witnesses)
         n_novel = len(novel)
         if n_novel:
             if st.nodes() + n_novel > self._max_nodes and st.nodes():
                 self.stats["evictions"] += 1
                 st.flush()
-                novel, miss, total = st.scan(witnesses)
+                with metrics.phase("witness_engine.intern"):
+                    novel, miss, total = st.scan(witnesses)
                 n_novel = len(novel)
             route_device = not self._native_route_certain() and (
                 self._device_route_wanted(novel)
+            )
+            self.stats["novel_bytes"] = self.stats.get("novel_bytes", 0) + sum(
+                map(len, novel)
             )
             if not route_device:
                 # the routed hasher for THIS batch is the host: hash inside
@@ -464,13 +517,18 @@ class WitnessEngine:
                 self.stats["native_batches"] = (
                     self.stats.get("native_batches", 0) + 1
                 )
-                verdict = st.finish_native()
+                # finish_native hashes + commits + joins in one C call; it
+                # times as "hash" because the novel-node keccak dominates
+                with metrics.phase("witness_engine.hash"):
+                    verdict = st.finish_native()
             else:
                 digests = self._hash_batch(novel, route_device=True)
                 self.stats["hashed"] += n_novel
-                verdict = st.finish(b"".join(digests))
+                with metrics.phase("witness_engine.linkage_join"):
+                    verdict = st.finish(b"".join(digests))
         else:
-            verdict = st.finish(None)
+            with metrics.phase("witness_engine.linkage_join"):
+                verdict = st.finish(None)
         self.stats["hits"] += total - miss
         return np.frombuffer(verdict, np.uint8).astype(bool)
 
@@ -528,24 +586,37 @@ class WitnessEngine:
         n = len(all_nodes)
         # `joined` kept alive across the ctypes calls
         joined, blob, offsets, lens = self._pack_blob(all_nodes)
-        rows, novel_idx, miss = core.scan(blob, offsets, lens)
+        with metrics.phase("witness_engine.intern"):
+            rows, novel_idx, miss = core.scan(blob, offsets, lens)
         if len(novel_idx):
             if core.nodes + len(novel_idx) > self._max_nodes and core.nodes:
                 self.stats["evictions"] += 1
                 core.flush()
-                rows, novel_idx, miss = core.scan(blob, offsets, lens)
+                with metrics.phase("witness_engine.intern"):
+                    rows, novel_idx, miss = core.scan(blob, offsets, lens)
             novel = [all_nodes[i] for i in novel_idx.tolist()]
             digests = self._hash_batch(novel)
             self.stats["hashed"] += len(novel)
+            self.stats["novel_bytes"] = self.stats.get("novel_bytes", 0) + sum(
+                map(len, novel)
+            )
             core.commit(blob, offsets, lens, rows, novel_idx, b"".join(digests))
         self.stats["hits"] += n - miss
         block_offs = np.zeros(n_blocks + 1, np.uint64)
         np.cumsum(counts, dtype=np.uint64, out=block_offs[1:])
         roots = b"".join(root for root, _nodes in witnesses)
-        return core.verdict(rows, block_offs, roots)
+        with metrics.phase("witness_engine.linkage_join"):
+            return core.verdict(rows, block_offs, roots)
 
     def _verify_interned(self, witnesses, all_nodes, counts, n_blocks):
-        rows = self.intern(all_nodes)
+        # the intern phase includes the nested witness_engine.hash phase of
+        # any novel nodes; linkage-join covers the integer-join verdict
+        with metrics.phase("witness_engine.intern"):
+            rows = self.intern(all_nodes)
+        with metrics.phase("witness_engine.linkage_join"):
+            return self._linkage_join(witnesses, rows, counts, n_blocks)
+
+    def _linkage_join(self, witnesses, rows, counts, n_blocks):
         block_id = np.repeat(np.arange(n_blocks, dtype=np.int64), counts)
 
         # the root digest resolves through the same refid space; -1 when the

@@ -523,16 +523,42 @@ def execute_stateless(
     STATE (a PragueFork must write its EIP-2935 history slots into the
     partial trie, where they are part of the post root); a prebuilt `fork`
     instance is accepted for forks that own no state (FrontierFork preloaded
-    with authenticated ancestor hashes)."""
-    from phant_tpu.blockchain.chain import Blockchain, BlockError
+    with authenticated ancestor hashes).
 
-    if not verify_witness_nodes(pre_state_root, nodes):
-        raise StatelessError("witness rejected: not a subtree of preStateRoot")
-    state = WitnessStateDB(pre_state_root, nodes, codes)
-    if fork is None and fork_factory is not None:
-        fork = fork_factory(state)
-    chain = Blockchain(
-        chain_id, state, parent_header, fork=fork, verify_state_root=True
-    )
-    result = chain.run_block(block)
-    return result, state.state_root()
+    Observability: the whole run is one `span("verify_block", block=n)` —
+    its JSON trace line carries the witness_verify / witness_decode /
+    execute / post_root phase split; failures count into
+    `stateless.errors{kind=...}`."""
+    from phant_tpu.blockchain.chain import Blockchain, BlockError
+    from phant_tpu.utils.trace import metrics, span
+
+    with span(
+        "verify_block",
+        block=block.header.block_number,
+        nodes=len(nodes),
+        codes=len(codes),
+    ):
+        try:
+            with metrics.phase("stateless.witness_verify"):
+                witness_ok = verify_witness_nodes(pre_state_root, nodes)
+            if not witness_ok:
+                raise StatelessError(
+                    "witness rejected: not a subtree of preStateRoot"
+                )
+            with metrics.phase("stateless.witness_decode"):
+                state = WitnessStateDB(pre_state_root, nodes, codes)
+                if fork is None and fork_factory is not None:
+                    fork = fork_factory(state)
+                chain = Blockchain(
+                    chain_id, state, parent_header, fork=fork, verify_state_root=True
+                )
+            with metrics.phase("stateless.execute"):
+                result = chain.run_block(block)
+            with metrics.phase("stateless.post_root"):
+                post_root = state.state_root()
+        except Exception as e:
+            # by-kind counter (bounded cardinality: exception class names)
+            metrics.count("stateless.errors", kind=type(e).__name__)
+            raise
+        metrics.count("stateless.blocks_verified")
+        return result, post_root

@@ -8,20 +8,32 @@ slot (SURVEY §5) to:
 
 - `phase(name)` — nestable wall-clock timers aggregated into a process
   metrics registry (count / total / min / max per phase),
-- `metrics` — counters + timers with a `report()` table and `snapshot()`,
+- `metrics` — counters (optionally labeled), gauges, fixed-bucket latency
+  histograms, and phase timers, with a `report()` table, a deep-copied
+  `snapshot()`, and a `prometheus_text()` standard text exposition,
+- `span(name, **attrs)` — a thread-safe (thread-local-stacked) per-block
+  span tracer: every top-level span emits ONE structured-JSON log line
+  carrying its duration, its nested phase timings, and any child spans,
 - `jax_profile(logdir)` — a context manager around the JAX profiler for
   device traces of the TPU kernels,
 - `scoped_logger(scope)` — the reference's scoped-logger idiom.
+
+Prometheus naming: internal metric names are dotted ("engine_api.requests");
+the exposition sanitizes them to `phant_[a-z0-9_]+` families (counters gain
+a `_total` suffix, phase timers a `_seconds` summary suffix). Every exported
+family must have an entry in METRIC_HELP — `make metrics-lint` enforces it.
 """
 
 from __future__ import annotations
 
 import contextlib
+import json
 import logging
+import re
 import threading
 import time
 from dataclasses import dataclass, field
-from typing import Dict, Iterator, Optional
+from typing import Dict, Iterator, List, Optional, Tuple
 
 
 def scoped_logger(scope: str) -> logging.Logger:
@@ -47,21 +59,153 @@ class TimerStat:
         return self.total_s / self.count if self.count else 0.0
 
 
+#: default latency buckets (seconds) — sub-ms kernel dispatches up through
+#: multi-second stateless executions
+DEFAULT_BUCKETS: Tuple[float, ...] = (
+    0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05,
+    0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
+)
+
+
+@dataclass
+class Histogram:
+    """Fixed-bucket histogram: cumulative-style exposition is derived at
+    render time; `counts[i]` is the count for bucket upper bound
+    `buckets[i]`, with one extra slot for +Inf."""
+
+    buckets: Tuple[float, ...] = DEFAULT_BUCKETS
+    counts: List[int] = field(default_factory=list)
+    sum: float = 0.0
+    count: int = 0
+
+    def __post_init__(self) -> None:
+        if not self.counts:
+            self.counts = [0] * (len(self.buckets) + 1)
+
+    def add(self, value: float) -> None:
+        self.sum += value
+        self.count += 1
+        for i, ub in enumerate(self.buckets):
+            if value <= ub:
+                self.counts[i] += 1
+                return
+        self.counts[-1] += 1
+
+
+def _labels_key(name: str, labels: dict) -> str:
+    """Composite storage key `name{k="v",...}` with sorted label names —
+    one flat dict keeps snapshot() trivially JSON-able."""
+    if not labels:
+        return name
+    inner = ",".join(f'{k}="{labels[k]}"' for k in sorted(labels))
+    return f"{name}{{{inner}}}"
+
+
+def split_labels(key: str) -> Tuple[str, str]:
+    """Inverse of _labels_key: ("name", 'k="v",...') — label part empty
+    for unlabeled metrics."""
+    if key.endswith("}") and "{" in key:
+        name, _, rest = key.partition("{")
+        return name, rest[:-1]
+    return key, ""
+
+
+def prometheus_name(name: str) -> str:
+    """Sanitize a dotted internal name to a `phant_[a-z0-9_]+` family."""
+    s = re.sub(r"[^a-zA-Z0-9_]", "_", name).lower()
+    return s if s.startswith("phant_") else "phant_" + s
+
+
+#: help strings for every exported metric family, keyed by INTERNAL base
+#: name (pre-sanitization, no labels). `make metrics-lint` fails the build
+#: when an exported family has no entry here — metric-name drift is caught
+#: at test time, not on a dashboard.
+METRIC_HELP: Dict[str, str] = {
+    # engine API server
+    "engine_api.requests": "Engine API JSON-RPC requests by method",
+    "engine_api.unknown_method": "Engine API requests for unknown methods (one bucket: untrusted strings)",
+    "engine_api.request_errors": "Engine API requests answered with a JSON-RPC error or HTTP >= 400",
+    "engine_api.client_disconnects": "Engine API responses aborted by client disconnect (BrokenPipe/ConnectionReset)",
+    "engine_api.inflight": "Engine API requests currently being handled",
+    "engine_api.request_seconds": "Engine API request latency (decode + handle + reply)",
+    "engine_api.decode_payload": "JSON -> ExecutionPayload decode phase",
+    "engine_api.new_payload": "engine_newPayloadV2/V3/V4 handler phase",
+    "engine_api.execute_stateless": "engine_executeStatelessPayloadV1 handler phase",
+    # stateless execution
+    "stateless.blocks_verified": "Stateless payloads fully executed and root-checked",
+    "stateless.errors": "Stateless executions aborted, by exception kind",
+    "stateless.witness_verify": "Linked-multiproof witness verification phase",
+    "stateless.witness_decode": "Witness -> WitnessStateDB materialization phase",
+    "stateless.execute": "Block execution phase over the witness-backed state",
+    "stateless.post_root": "Post-state-root recompute phase over the partial trie",
+    # memoized witness engine
+    "witness_engine.interned_nodes": "Unique trie nodes currently interned in the witness engine",
+    "witness_engine.interned_digests": "Unique 32-byte digests currently interned (nodes + child refs)",
+    "witness_engine.cache_hits": "Witness nodes served from the interning cache",
+    "witness_engine.cache_misses": "Witness nodes that had to be hashed (novel nodes)",
+    "witness_engine.evictions": "Generation flushes of the interned set (max_nodes crossed)",
+    "witness_engine.novel_bytes_hashed": "Bytes of novel witness nodes hashed",
+    "witness_engine.verify_batch": "Whole verify_batch calls (scan + hash + linkage)",
+    "witness_engine.intern": "Interning/scan phase of verify_batch (cache probe + table insert)",
+    "witness_engine.hash": "Novel-node keccak phase of verify_batch (includes the C-side commit+join on the finish_native fast path)",
+    "witness_engine.linkage_join": "Parent->child linkage join / verdict phase of verify_batch",
+    # crypto backend dispatch
+    "keccak.batches": "Batched keccak dispatches by backend",
+    "keccak.bytes": "Payload bytes submitted to batched keccak by backend",
+    "keccak.device_dispatch": "Host->device upload + kernel dispatch phase",
+    "keccak.host_readback": "Device->host digest readback (the honest sync) phase",
+    "backend.selected": "Crypto-backend selections by backend (process start + bench flips)",
+    "backend.offload_decisions": "Adaptive offload-gate verdicts by outcome (device/native)",
+}
+
+
 class Metrics:
-    """Process-global counters and phase timers (thread-safe)."""
+    """Process-global counters, gauges, histograms, and phase timers
+    (thread-safe; `snapshot()` deep-copies under the lock so exposition
+    never reads torn values)."""
 
     def __init__(self):
         self._lock = threading.Lock()
         self._counters: Dict[str, int] = {}
         self._timers: Dict[str, TimerStat] = {}
+        self._gauges: Dict[str, float] = {}
+        self._hists: Dict[str, Histogram] = {}
 
-    def count(self, name: str, delta: int = 1) -> None:
+    def count(self, name: str, delta: int = 1, **labels) -> None:
+        key = _labels_key(name, labels)
         with self._lock:
-            self._counters[name] = self._counters.get(name, 0) + delta
+            self._counters[key] = self._counters.get(key, 0) + delta
+
+    def gauge_set(self, name: str, value: float, **labels) -> None:
+        key = _labels_key(name, labels)
+        with self._lock:
+            self._gauges[key] = value
+
+    def gauge_add(self, name: str, delta: float, **labels) -> None:
+        key = _labels_key(name, labels)
+        with self._lock:
+            self._gauges[key] = self._gauges.get(key, 0) + delta
 
     def observe(self, name: str, seconds: float) -> None:
         with self._lock:
             self._timers.setdefault(name, TimerStat()).add(seconds)
+        sp = current_span()
+        if sp is not None:
+            sp.add_phase(name, seconds)
+
+    def observe_hist(
+        self,
+        name: str,
+        value: float,
+        buckets: Optional[Tuple[float, ...]] = None,
+        **labels,
+    ) -> None:
+        key = _labels_key(name, labels)
+        with self._lock:
+            h = self._hists.get(key)
+            if h is None:
+                h = self._hists[key] = Histogram(buckets or DEFAULT_BUCKETS)
+            h.add(value)
 
     @contextlib.contextmanager
     def phase(self, name: str) -> Iterator[None]:
@@ -73,6 +217,9 @@ class Metrics:
             self.observe(name, time.perf_counter() - t0)
 
     def snapshot(self) -> dict:
+        """Deep copy of every table under the lock: TimerStat/Histogram
+        objects keep mutating concurrently, and exposition must never read
+        a torn (count updated, sum not yet) pair."""
         with self._lock:
             return {
                 "counters": dict(self._counters),
@@ -86,12 +233,24 @@ class Metrics:
                     }
                     for k, v in self._timers.items()
                 },
+                "gauges": dict(self._gauges),
+                "histograms": {
+                    k: {
+                        "buckets": list(h.buckets),
+                        "counts": list(h.counts),
+                        "sum": h.sum,
+                        "count": h.count,
+                    }
+                    for k, h in self._hists.items()
+                },
             }
 
     def reset(self) -> None:
         with self._lock:
             self._counters.clear()
             self._timers.clear()
+            self._gauges.clear()
+            self._hists.clear()
 
     def report(self) -> str:
         """Box table of every phase/counter (same presentation family as the
@@ -100,6 +259,10 @@ class Metrics:
         rows = [("metric", "count", "total", "mean")]
         for name, c in sorted(snap["counters"].items()):
             rows.append((name, str(c), "-", "-"))
+        for name, g in sorted(snap["gauges"].items()):
+            rows.append((name, f"{g:g}", "-", "-"))
+        for name, h in sorted(snap["histograms"].items()):
+            rows.append((name, str(h["count"]), f"{h['sum'] * 1e3:.2f}ms", "-"))
         for name, t in sorted(snap["timers"].items()):
             rows.append(
                 (
@@ -122,6 +285,71 @@ class Metrics:
         out.append(line("└", "┴", "┘"))
         return "\n".join(out)
 
+    # -- Prometheus text exposition -----------------------------------------
+
+    def prometheus_text(self) -> str:
+        """Standard Prometheus text exposition (version 0.0.4) of every
+        table. Counters export as `<family>_total`, phase timers as
+        `<family>_seconds` summaries (count/sum), histograms with
+        cumulative `_bucket{le=...}` series."""
+        snap = self.snapshot()
+        out: List[str] = []
+        emitted_help: set = set()
+
+        def header(base: str, family: str, mtype: str) -> None:
+            if family in emitted_help:
+                return
+            emitted_help.add(family)
+            help_s = METRIC_HELP.get(base)
+            if help_s:
+                out.append(f"# HELP {family} {help_s}")
+            out.append(f"# TYPE {family} {mtype}")
+
+        def fmt(v: float) -> str:
+            return repr(v) if isinstance(v, float) else str(v)
+
+        # group labeled series under one family so HELP/TYPE emit once
+        for key in sorted(snap["counters"]):
+            base, labels = split_labels(key)
+            family = prometheus_name(base)
+            if not family.endswith("_total"):
+                family += "_total"
+            header(base, family, "counter")
+            lab = f"{{{labels}}}" if labels else ""
+            out.append(f"{family}{lab} {snap['counters'][key]}")
+        for key in sorted(snap["gauges"]):
+            base, labels = split_labels(key)
+            family = prometheus_name(base)
+            header(base, family, "gauge")
+            lab = f"{{{labels}}}" if labels else ""
+            out.append(f"{family}{lab} {fmt(snap['gauges'][key])}")
+        for key in sorted(snap["histograms"]):
+            base, labels = split_labels(key)
+            family = prometheus_name(base)
+            header(base, family, "histogram")
+            h = snap["histograms"][key]
+            cum = 0
+            for ub, c in zip(h["buckets"], h["counts"]):
+                cum += c
+                lab = f'le="{fmt(float(ub))}"' + (f",{labels}" if labels else "")
+                out.append(f"{family}_bucket{{{lab}}} {cum}")
+            lab = 'le="+Inf"' + (f",{labels}" if labels else "")
+            out.append(f"{family}_bucket{{{lab}}} {h['count']}")
+            lab = f"{{{labels}}}" if labels else ""
+            out.append(f"{family}_sum{lab} {fmt(h['sum'])}")
+            out.append(f"{family}_count{lab} {h['count']}")
+        for key in sorted(snap["timers"]):
+            base, labels = split_labels(key)
+            family = prometheus_name(base)
+            if not family.endswith("_seconds"):
+                family += "_seconds"
+            header(base, family, "summary")
+            lab = f"{{{labels}}}" if labels else ""
+            t = snap["timers"][key]
+            out.append(f"{family}_sum{lab} {fmt(t['total_s'])}")
+            out.append(f"{family}_count{lab} {t['count']}")
+        return "\n".join(out) + "\n"
+
 
 #: process-global registry (importable singleton)
 metrics = Metrics()
@@ -130,6 +358,83 @@ metrics = Metrics()
 def phase(name: str):
     """Module-level shorthand for `metrics.phase(name)`."""
     return metrics.phase(name)
+
+
+# ---------------------------------------------------------------------------
+# span tracing
+# ---------------------------------------------------------------------------
+
+_span_log = logging.getLogger("phant_tpu.span")
+_span_tls = threading.local()
+
+
+class Span:
+    """One traced operation: wall-clock duration + the phase timings that
+    ran inside it (fed by Metrics.observe) + any child spans. Spans stack
+    per-thread (thread-local), which is the thread-safety mechanism —
+    concurrent request threads each trace their own block without locking."""
+
+    __slots__ = ("name", "attrs", "duration_s", "phases", "children")
+
+    def __init__(self, name: str, attrs: dict):
+        self.name = name
+        self.attrs = attrs
+        self.duration_s = 0.0
+        self.phases: Dict[str, List[float]] = {}  # name -> [count, total_s]
+        self.children: List[dict] = []
+
+    def add_phase(self, name: str, seconds: float) -> None:
+        st = self.phases.get(name)
+        if st is None:
+            self.phases[name] = [1, seconds]
+        else:
+            st[0] += 1
+            st[1] += seconds
+
+    def to_dict(self) -> dict:
+        d: dict = {"span": self.name, **self.attrs}
+        d["duration_ms"] = round(self.duration_s * 1e3, 3)
+        if self.phases:
+            d["phases"] = {
+                k: {"count": c, "total_ms": round(t * 1e3, 3)}
+                for k, (c, t) in self.phases.items()
+            }
+        if self.children:
+            d["children"] = self.children
+        return d
+
+
+def current_span() -> Optional[Span]:
+    stack = getattr(_span_tls, "stack", None)
+    return stack[-1] if stack else None
+
+
+@contextlib.contextmanager
+def span(name: str, **attrs) -> Iterator[Span]:
+    """Trace one operation: `with span("verify_block", block=n): ...`.
+
+    Phase timings recorded inside (via `metrics.phase` / `observe`) attach
+    to the innermost open span of the current thread. A nested span folds
+    its summary into its parent; each TOP-LEVEL span emits one
+    structured-JSON log line (logger `phant_tpu.span`, INFO) with the
+    nested phase timings — the per-block trace record."""
+    sp = Span(name, attrs)
+    stack = getattr(_span_tls, "stack", None)
+    if stack is None:
+        stack = _span_tls.stack = []
+    stack.append(sp)
+    t0 = time.perf_counter()
+    try:
+        yield sp
+    finally:
+        sp.duration_s = time.perf_counter() - t0
+        stack.pop()
+        if stack:
+            stack[-1].children.append(sp.to_dict())
+        elif _span_log.isEnabledFor(logging.INFO):
+            # serialization is per-block work on the serving hot path —
+            # skip it entirely when nobody listens
+            _span_log.info(json.dumps(sp.to_dict(), default=str))
 
 
 @contextlib.contextmanager

@@ -20,18 +20,28 @@ if os.environ.get("PHANT_TEST_TPU", "0") in ("", "0"):
     # otherwise re-route tpu-backend differential tests to the CPU path;
     # here the CPU-mesh jax run IS the point
     os.environ["PHANT_ALLOW_JAX_CPU"] = "1"
-    # per-session PRIVATE compile cache: jax segfaults (not raises) on a
-    # cache entry corrupted by concurrent writers, so the test process must
-    # never share build/jax_cache with bench subprocesses or other runs;
-    # an isolated dir keeps the session single-writer AND fast
+    # test-suite compile cache: jax segfaults (not raises) on a cache
+    # entry corrupted by concurrent writers, so each process CLASS gets
+    # its own dir — bench uses build/jax_cache, check.sh groups use
+    # build/jax_cache_tests (sequential), and direct pytest invocations
+    # default to build/jax_cache_pytest here. The dir is persistent on
+    # purpose: the previous throwaway per-session tmpdir made EVERY
+    # pytest invocation recompile every kernel cold — the tier-1 driver
+    # command (single process, 870s budget) timed out at ~26% of the
+    # suite purely on recompiles (test_cancun_block_end_to_end alone:
+    # 163s cold vs 79s warm). Entries already present are read-only, so
+    # repeat runs shrink the sporadic write-a-cache-entry SIGSEGV window
+    # rather than widening it. Residual risk: two SIMULTANEOUS direct
+    # pytest runs share this dir — don't do that (or point
+    # PHANT_JAX_CACHE somewhere private, which always wins).
     if "PHANT_JAX_CACHE" not in os.environ:
-        import atexit
-        import shutil
-        import tempfile
-
-        _cache_dir = tempfile.mkdtemp(prefix="phant-jax-cache-")
+        _cache_dir = os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            "build",
+            "jax_cache_pytest",
+        )
+        os.makedirs(_cache_dir, exist_ok=True)
         os.environ["PHANT_JAX_CACHE"] = _cache_dir
-        atexit.register(shutil.rmtree, _cache_dir, ignore_errors=True)
     os.environ.setdefault("PHANT_TPU_FORCE_TRIE", "1")  # bypass the link
     # cost model: differential tests must exercise the device dispatch even
     # though a CPU-mesh "link" never pays off for tiny tries

@@ -335,6 +335,93 @@ def test_http_server_roundtrip():
         server.shutdown()
 
 
+def test_metrics_and_healthz_endpoints():
+    """GET /metrics serves Prometheus text exposition and GET /healthz a
+    liveness probe from the SAME EngineAPIServer; the request counter and
+    latency histogram move after a newPayload POST."""
+    from phant_tpu.utils.trace import metrics
+
+    metrics.reset()
+    chain = _fresh_chain()
+    server = EngineAPIServer(chain, host="127.0.0.1", port=0)
+    server.serve_in_background()
+    try:
+        base = f"http://127.0.0.1:{server.port}"
+        health = json.loads(urllib.request.urlopen(base + "/healthz", timeout=10).read())
+        assert health["status"] == "ok"
+        assert "uptime_s" in health and "version" in health
+
+        def scrape() -> str:
+            with urllib.request.urlopen(base + "/metrics", timeout=10) as resp:
+                assert resp.headers["Content-Type"].startswith("text/plain")
+                return resp.read().decode()
+
+        before = scrape()
+        assert (
+            'phant_engine_api_requests_total{method="engine_newPayloadV2"}'
+            not in before
+        )
+
+        body = json.dumps(
+            {
+                "jsonrpc": "2.0",
+                "id": 1,
+                "method": "engine_newPayloadV2",
+                "params": [_with_real_block_hash(_valid_payload_json())],
+            }
+        ).encode()
+        req = urllib.request.Request(
+            base + "/", data=body, headers={"Content-Type": "application/json"}
+        )
+        out = json.loads(urllib.request.urlopen(req, timeout=10).read())
+        assert out["result"]["status"] == "VALID"
+
+        after = scrape()
+        assert (
+            'phant_engine_api_requests_total{method="engine_newPayloadV2"} 1'
+            in after
+        )
+        # the POST was latency-histogrammed and help/type lines are present
+        assert "# TYPE phant_engine_api_request_seconds histogram" in after
+        assert "phant_engine_api_request_seconds_count 1" in after
+        assert "# HELP phant_engine_api_requests_total" in after
+        # unknown GET paths 404 without killing the server
+        with pytest.raises(urllib.error.HTTPError) as exc_info:
+            urllib.request.urlopen(base + "/nope", timeout=10)
+        assert exc_info.value.code == 404
+        assert json.loads(urllib.request.urlopen(base + "/healthz", timeout=10).read())[
+            "status"
+        ] == "ok"
+    finally:
+        server.shutdown()
+
+
+def test_standalone_metrics_server():
+    """`--metrics` surface: serve_metrics binds /metrics + /healthz on a
+    dedicated port with no Engine API attached."""
+    from phant_tpu.engine_api.server import serve_metrics
+
+    srv = serve_metrics(host="127.0.0.1", port=0)
+    try:
+        base = f"http://127.0.0.1:{srv.port}"
+        text = urllib.request.urlopen(base + "/metrics", timeout=10).read().decode()
+        assert text.endswith("\n")
+        health = json.loads(urllib.request.urlopen(base + "/healthz", timeout=10).read())
+        assert health["status"] == "ok"
+    finally:
+        srv.shutdown()
+
+
+def test_cli_observability_flags():
+    args = build_parser().parse_args(
+        ["--metrics", "--metrics-port", "9777", "--trace-logdir", "/tmp/tr"]
+    )
+    assert args.metrics and args.metrics_port == 9777
+    assert args.trace_logdir == "/tmp/tr"
+    args = build_parser().parse_args([])
+    assert not args.metrics and args.trace_logdir is None
+
+
 def test_newpayload_v3_cancun_roundtrip():
     """engine_newPayloadV3: the side-channel parentBeaconBlockRoot must fold
     into the header (it is part of blockHash), the expected blob-hash list
