@@ -1,0 +1,39 @@
+"""phantlint — AST-based JAX/TPU hazard analysis for this codebase.
+
+A small plugin-rule static-analysis framework: `symbols.py` parses the
+package and resolves a lightweight module/symbol table + call graph,
+`core.py` drives per-rule visitors with file:line findings, a
+`# phantlint: disable=RULE` escape hatch, and a checked-in baseline for
+grandfathered findings. Shipped rules (phant_tpu/analysis/rules/):
+
+  HOSTSYNC    accidental device->host syncs on the verification hot path
+  DTYPE       int-literal promotion hazards in the uint32 lane modules
+  JITHYGIENE  jit static/closure mistakes that compile-and-misbehave
+  LOCK        lock-guarded state touched without the lock
+  METRICNAME  metric names: literal, sanitizable, and in METRIC_HELP
+
+CLI: `scripts/phantlint.py` (wired as `make lint`, runs first in
+`scripts/check.sh`). Pure `ast` — never imports the code under analysis,
+so the gate lints the full package in ~2s and without jax.
+"""
+
+from phant_tpu.analysis.core import (
+    AnalysisResult,
+    Analyzer,
+    Finding,
+    Rule,
+    load_baseline,
+    save_baseline,
+)
+from phant_tpu.analysis.rules import ALL_RULES, default_rules
+
+__all__ = [
+    "ALL_RULES",
+    "AnalysisResult",
+    "Analyzer",
+    "Finding",
+    "Rule",
+    "default_rules",
+    "load_baseline",
+    "save_baseline",
+]

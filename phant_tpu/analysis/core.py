@@ -1,0 +1,309 @@
+"""phantlint core: findings, suppression, baseline, and the analyzer driver.
+
+One analyzer, plugin rules. Each rule sees the whole parsed `Project`
+(phant_tpu/analysis/symbols.py) and yields `Finding`s with file:line
+positions. Three layers of triage, in order:
+
+  1. `# phantlint: disable=RULE[,RULE]` comments — on the offending line,
+     or on a comment line directly above it — suppress in place. This is
+     the escape hatch for INTENTIONAL hazards (a timed host readback, a
+     benign lock-free lazy init); the comment carries the reason in prose.
+  2. The baseline file (scripts/phantlint_baseline.json) grandfathers
+     known findings by fingerprint so the gate can land before every
+     legacy finding is fixed. Fingerprints hash (rule, path, enclosing
+     scope, message) but NOT the line number — shifting code around does
+     not resurrect a baselined finding.
+  3. Anything left is a NEW finding and fails the gate (exit 1).
+
+The analyzer never imports the code under analysis — pure `ast`, so the
+commit gate lints the full package in ~2s and without jax.
+"""
+
+from __future__ import annotations
+
+import ast
+import hashlib
+import json
+import re
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Dict, Iterable, Iterator, List, Optional, Sequence, Set
+
+from phant_tpu.analysis.symbols import ModuleInfo, Project, parse_module
+
+_DISABLE_RE = re.compile(r"#\s*phantlint:\s*disable=([A-Za-z0-9_,\s]+)")
+_DISABLE_FILE_RE = re.compile(r"#\s*phantlint:\s*disable-file=([A-Za-z0-9_,\s]+)")
+
+
+@dataclass(frozen=True)
+class Finding:
+    rule: str
+    path: str  # repo-relative, posix separators
+    line: int
+    col: int
+    message: str
+    severity: str = "error"
+    context: str = ""  # enclosing qualname (stable across line shifts)
+
+    def fingerprint(self, occurrence: int = 0) -> str:
+        """Line-number-independent identity. `occurrence` disambiguates
+        IDENTICAL findings in the same scope (e.g. two `int(jnp.sum(tiny))`
+        probes in one function): without it, baselining the first would
+        silently mask a second one added later. Occurrence 0 omits the
+        suffix so existing baselines keep matching."""
+        key = f"{self.rule}|{self.path}|{self.context}|{self.message}"
+        if occurrence:
+            key += f"|#{occurrence}"
+        return hashlib.sha1(key.encode()).hexdigest()[:16]
+
+    def to_dict(self) -> dict:
+        return {
+            "rule": self.rule,
+            "path": self.path,
+            "line": self.line,
+            "col": self.col,
+            "severity": self.severity,
+            "message": self.message,
+            "context": self.context,
+            "fingerprint": self.fingerprint(),
+        }
+
+    def render(self) -> str:
+        return (
+            f"{self.path}:{self.line}:{self.col}: "
+            f"{self.rule} {self.severity}: {self.message}"
+        )
+
+
+class Rule:
+    """Base class for phantlint rules."""
+
+    name: str = "RULE"
+    description: str = ""
+
+    def run(self, project: Project) -> Iterator[Finding]:  # pragma: no cover
+        raise NotImplementedError
+
+    # helper for subclasses
+    def finding(
+        self,
+        project: Project,
+        module: ModuleInfo,
+        node: ast.AST,
+        message: str,
+        context: str = "",
+        severity: str = "error",
+    ) -> Finding:
+        return Finding(
+            rule=self.name,
+            path=rel_path(module.path),
+            line=getattr(node, "lineno", 1),
+            col=getattr(node, "col_offset", 0) + 1,
+            message=message,
+            severity=severity,
+            context=context,
+        )
+
+
+def rel_path(path: Path) -> str:
+    """Package-root-relative posix path: walk up the __init__.py chain so
+    "…/anywhere/phant_tpu/ops/x.py" is always "phant_tpu/ops/x.py" no
+    matter where phantlint runs from. Baseline fingerprints embed this
+    path, so it must NOT depend on the invocation cwd (a cwd-relative
+    path would resurrect every grandfathered finding the first time the
+    tool runs from an editor or CI working dir outside the repo root).
+    Non-package files fall back to cwd-relative, then absolute."""
+    path = path.resolve()
+    parts = [path.name]
+    d = path.parent
+    found_pkg = False
+    while (d / "__init__.py").exists():
+        found_pkg = True
+        parts.insert(0, d.name)
+        d = d.parent
+    if found_pkg:
+        return "/".join(parts)
+    try:
+        return path.relative_to(Path.cwd().resolve()).as_posix()
+    except ValueError:
+        return path.as_posix()
+
+
+# ---------------------------------------------------------------------------
+# suppression comments
+# ---------------------------------------------------------------------------
+
+
+def _disabled_lines(module: ModuleInfo) -> Dict[int, Set[str]]:
+    """line (1-based) -> set of rule names disabled there. A directive on a
+    pure-comment line applies to the next non-comment line as well, so an
+    annotation can sit above a long expression."""
+    out: Dict[int, Set[str]] = {}
+    file_wide: Set[str] = set()
+    pending: Set[str] = set()
+    for i, text in enumerate(module.lines, start=1):
+        m = _DISABLE_FILE_RE.search(text)
+        if m:
+            file_wide |= {r.strip() for r in m.group(1).split(",") if r.strip()}
+        m = _DISABLE_RE.search(text)
+        rules = (
+            {r.strip() for r in m.group(1).split(",") if r.strip()} if m else set()
+        )
+        stripped = text.strip()
+        if rules:
+            out.setdefault(i, set()).update(rules)
+            if stripped.startswith("#"):
+                pending |= rules  # standalone comment: applies below too
+                continue
+        if pending and stripped and not stripped.startswith("#"):
+            out.setdefault(i, set()).update(pending)
+            pending = set()
+    if file_wide:
+        for i in range(1, len(module.lines) + 1):
+            out.setdefault(i, set()).update(file_wide)
+    return out
+
+
+def is_suppressed(finding: Finding, disabled: Dict[int, Set[str]]) -> bool:
+    rules = disabled.get(finding.line)
+    if not rules:
+        return False
+    return finding.rule in rules or "all" in rules
+
+
+# ---------------------------------------------------------------------------
+# baseline
+# ---------------------------------------------------------------------------
+
+
+def load_baseline(path: Path) -> Set[str]:
+    """Set of grandfathered fingerprints; empty for a missing file."""
+    if not path.exists():
+        return set()
+    data = json.loads(path.read_text())
+    return {f["fingerprint"] for f in data.get("findings", [])}
+
+
+def assign_fingerprints(findings: Sequence[Finding]) -> List[str]:
+    """Occurrence-disambiguated fingerprint per finding, in input order
+    (callers pass findings sorted by path/line so ordinals are stable).
+    The ONE shared implementation for both writing and comparing
+    baselines — a divergence here would mask or resurrect findings."""
+    counts: Dict[str, int] = {}
+    out: List[str] = []
+    for f in findings:
+        base = f.fingerprint()
+        n = counts.get(base, 0)
+        counts[base] = n + 1
+        out.append(f.fingerprint(occurrence=n))
+    return out
+
+
+def save_baseline(path: Path, findings: Sequence[Finding]) -> None:
+    ordered = sorted(findings, key=lambda f: (f.path, f.line, f.col, f.rule))
+    fps = assign_fingerprints(ordered)
+    data = {
+        "version": 1,
+        "findings": [
+            {
+                "fingerprint": fp,
+                "rule": f.rule,
+                "path": f.path,
+                "message": f.message,
+                "context": f.context,
+            }
+            for f, fp in zip(ordered, fps)
+        ],
+    }
+    path.write_text(json.dumps(data, indent=2) + "\n")
+
+
+# ---------------------------------------------------------------------------
+# analyzer driver
+# ---------------------------------------------------------------------------
+
+
+def discover_modules(paths: Sequence[Path]) -> Dict[str, ModuleInfo]:
+    """Parse every .py under `paths`. Module names are derived from the
+    package root (the highest ancestor chain of __init__.py dirs), so
+    scanning `phant_tpu/` from the repo root yields `phant_tpu.*` names."""
+    modules: Dict[str, ModuleInfo] = {}
+    files: List[Path] = []
+    for p in paths:
+        p = Path(p)
+        if p.is_dir():
+            files.extend(sorted(p.rglob("*.py")))
+        elif p.suffix == ".py":
+            files.append(p)
+    for f in files:
+        if "__pycache__" in f.parts:
+            continue
+        name = _module_name(f)
+        mi = parse_module(name, f)
+        if mi is not None:
+            modules[name] = mi
+    return modules
+
+
+def _module_name(path: Path) -> str:
+    path = path.resolve()
+    parts = [path.stem] if path.stem != "__init__" else []
+    d = path.parent
+    while (d / "__init__.py").exists():
+        parts.insert(0, d.name)
+        d = d.parent
+    return ".".join(parts) if parts else path.stem
+
+
+@dataclass
+class AnalysisResult:
+    findings: List[Finding] = field(default_factory=list)  # post-suppression
+    new: List[Finding] = field(default_factory=list)  # post-baseline
+    suppressed: int = 0
+    baselined: int = 0
+    modules: int = 0
+
+
+class Analyzer:
+    def __init__(
+        self,
+        paths: Sequence[Path],
+        rules: Sequence[Rule],
+        baseline: Optional[Path] = None,
+    ):
+        self.paths = [Path(p) for p in paths]
+        self.rules = list(rules)
+        self.baseline_path = baseline
+
+    def run(self) -> AnalysisResult:
+        modules = discover_modules(self.paths)
+        project = Project(modules)
+        disabled = {name: _disabled_lines(mi) for name, mi in modules.items()}
+        by_path = {rel_path(mi.path): mi.name for mi in modules.values()}
+        result = AnalysisResult(modules=len(modules))
+        raw: List[Finding] = []
+        for rule in self.rules:
+            raw.extend(rule.run(project))
+        for f in sorted(raw, key=lambda f: (f.path, f.line, f.col, f.rule)):
+            mod_name = by_path.get(f.path)
+            if mod_name is not None and is_suppressed(f, disabled[mod_name]):
+                result.suppressed += 1
+                continue
+            result.findings.append(f)
+        base = (
+            load_baseline(self.baseline_path)
+            if self.baseline_path is not None
+            else set()
+        )
+        for f, fp in zip(result.findings, assign_fingerprints(result.findings)):
+            if fp in base:
+                result.baselined += 1
+            else:
+                result.new.append(f)
+        return result
+
+
+def iter_calls(root: ast.AST) -> Iterable[ast.Call]:
+    for node in ast.walk(root):
+        if isinstance(node, ast.Call):
+            yield node

@@ -1,0 +1,40 @@
+"""phantlint rule registry.
+
+Each rule is a `phant_tpu.analysis.core.Rule` subclass; `default_rules()`
+instantiates the shipped set with this repo's hot-path entry points and
+lane-module scope. Third-party/experimental rules register by appending a
+class to `ALL_RULES` (or passing instances straight to `Analyzer`)."""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+from phant_tpu.analysis.core import Rule
+from phant_tpu.analysis.rules.dtype import DTypeRule
+from phant_tpu.analysis.rules.hostsync import HostSyncRule
+from phant_tpu.analysis.rules.jithygiene import JitHygieneRule
+from phant_tpu.analysis.rules.lock import LockRule
+from phant_tpu.analysis.rules.metricname import MetricNameRule
+
+ALL_RULES = [
+    HostSyncRule,
+    DTypeRule,
+    JitHygieneRule,
+    LockRule,
+    MetricNameRule,
+]
+
+
+def default_rules(only: Optional[Sequence[str]] = None) -> List[Rule]:
+    """Instances of every shipped rule; `only` filters by rule name."""
+    rules: List[Rule] = [cls() for cls in ALL_RULES]
+    if only is not None:
+        wanted = {n.upper() for n in only}
+        unknown = wanted - {r.name for r in rules}
+        if unknown:
+            raise ValueError(
+                f"unknown rule(s) {sorted(unknown)}; "
+                f"known: {[r.name for r in rules]}"
+            )
+        rules = [r for r in rules if r.name in wanted]
+    return rules

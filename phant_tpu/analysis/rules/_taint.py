@@ -1,0 +1,152 @@
+"""Function-local device-value taint tracking (shared by HOSTSYNC/DTYPE).
+
+A value is "device-tainted" when it (transitively) comes from a call into
+jax — a jit-compiled project function, a `jnp.*`/`jax.*` call through any
+import alias, or a `jax.jit(f)(...)` inline dispatch — or, optionally,
+from a parameter of a jitted function (inside jit every argument is a
+tracer). Taint propagates through assignments, arithmetic, subscripts,
+attribute access, and method calls on tainted receivers, to a fixed point
+over the function body (nested defs included: closures see the enclosing
+taint, which is how deferred `resolve()` readbacks are caught).
+
+This is a heuristic, not an escape analysis: parameters of plain host
+functions are NOT tainted (the flag belongs at the call site that built
+the device value), and unknown calls do not launder taint away only when
+the receiver itself is tainted. Under-approximation can suppress a
+finding; it cannot invent one.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Optional, Set
+
+from phant_tpu.analysis.symbols import ModuleInfo, Project, _dotted
+
+
+def resolve_external(mi: ModuleInfo, dotted: str) -> str:
+    """Expand the leading alias of a dotted name through the module's
+    imports: "jnp.sum" -> "jax.numpy.sum", "np.asarray" -> "numpy.asarray"."""
+    head, _, rest = dotted.partition(".")
+    target = mi.imports.get(head, head)
+    return f"{target}.{rest}" if rest else target
+
+
+def is_jax_call(project: Project, mi: ModuleInfo, call: ast.Call) -> bool:
+    """Does this call produce a device value (jax/jnp/jitted function)?"""
+    func = call.func
+    # jax.jit(f)(...) inline dispatch
+    if isinstance(func, ast.Call):
+        d = _dotted(func.func)
+        if d is not None and resolve_external(mi, d).startswith("jax."):
+            return True
+        return False
+    d = _dotted(func)
+    if d is None:
+        return False
+    q = project.resolve_name(mi.name, d)
+    if q is not None:
+        fi = project.functions.get(q)
+        if fi is not None and fi.jitted:
+            return True
+        return False
+    full = resolve_external(mi, d)
+    return full == "jax" or full.startswith(("jax.", "jax_"))
+
+
+class Taint:
+    """Tainted-local-name computation for one function body."""
+
+    def __init__(
+        self,
+        project: Project,
+        mi: ModuleInfo,
+        fn: ast.AST,
+        taint_params: bool = False,
+    ):
+        self.project = project
+        self.mi = mi
+        self.fn = fn
+        self.names: Set[str] = set()
+        if taint_params:
+            a = fn.args
+            for arg in (
+                list(a.posonlyargs) + list(a.args) + list(a.kwonlyargs)
+            ):
+                self.names.add(arg.arg)
+            if a.vararg:
+                self.names.add(a.vararg.arg)
+        self._fixed_point()
+
+    def _fixed_point(self) -> None:
+        changed = True
+        while changed:
+            changed = False
+            for node in ast.walk(self.fn):
+                targets = None
+                value: Optional[ast.AST] = None
+                if isinstance(node, ast.Assign):
+                    targets, value = node.targets, node.value
+                elif isinstance(node, ast.AugAssign):
+                    targets, value = [node.target], node.value
+                elif isinstance(node, ast.AnnAssign) and node.value is not None:
+                    targets, value = [node.target], node.value
+                if targets is None or value is None:
+                    continue
+                if not self.tainted(value):
+                    continue
+                for tgt in targets:
+                    for n in self._target_names(tgt):
+                        if n not in self.names:
+                            self.names.add(n)
+                            changed = True
+
+    @staticmethod
+    def _target_names(tgt: ast.AST):
+        if isinstance(tgt, ast.Name):
+            yield tgt.id
+        elif isinstance(tgt, (ast.Tuple, ast.List)):
+            for elt in tgt.elts:
+                yield from Taint._target_names(elt)
+        elif isinstance(tgt, ast.Starred):
+            yield from Taint._target_names(tgt.value)
+
+    def tainted(self, node: ast.AST) -> bool:
+        """Is this expression (possibly) a device value?"""
+        if isinstance(node, ast.Name):
+            return node.id in self.names
+        if isinstance(node, ast.Call):
+            if is_jax_call(self.project, self.mi, node):
+                return True
+            # method call on a tainted receiver (x.reshape, x.at[...].set)
+            func = node.func
+            while isinstance(func, (ast.Attribute, ast.Subscript)):
+                func = func.value
+            if isinstance(func, ast.Call):
+                return self.tainted(func)
+            return isinstance(func, ast.Name) and func.id in self.names
+        if isinstance(node, (ast.Attribute, ast.Subscript, ast.Starred)):
+            return self.tainted(node.value)
+        if isinstance(node, ast.BinOp):
+            return self.tainted(node.left) or self.tainted(node.right)
+        if isinstance(node, ast.UnaryOp):
+            return self.tainted(node.operand)
+        if isinstance(node, ast.BoolOp):
+            return any(self.tainted(v) for v in node.values)
+        if isinstance(node, ast.Compare):
+            return self.tainted(node.left) or any(
+                self.tainted(c) for c in node.comparators
+            )
+        if isinstance(node, ast.IfExp):
+            return self.tainted(node.body) or self.tainted(node.orelse)
+        if isinstance(node, (ast.Tuple, ast.List)):
+            return any(self.tainted(e) for e in node.elts)
+        return False
+
+
+def snippet(node: ast.AST, limit: int = 48) -> str:
+    try:
+        text = ast.unparse(node)
+    except Exception:  # pragma: no cover — unparse failure on exotic nodes
+        return "<expr>"
+    return text if len(text) <= limit else text[: limit - 1] + "…"

@@ -1,0 +1,249 @@
+"""DTYPE: promotion/overflow hazards in the uint32 lane-math modules.
+
+The device kernels do all 64-bit work as uint32 lane pairs; a Python int
+literal slipped into that math without an explicit cast either overflows
+int32 at trace time or silently promotes a lane to a wider dtype, which
+breaks bit-exactness against the CPU backends (differential tests catch
+it late; this rule catches it at commit time). Scope defaults to the
+lane-math modules named by the framework: ops/keccak_jax.py,
+ops/secp256k1_jax.py, ops/witness_jax.py.
+
+Checks, applied inside "lane functions" (jit entry points plus their
+intra-scope transitive callees, whose parameters are tracers):
+
+  * D1 — a bare int literal that does not fit int32 (|v| >= 2**31) mixed
+    into tainted lane math (binop operand, `.set(...)` on a tainted
+    `.at[]` chain, or argument beside a tainted one) without a direct
+    `jnp.uint32(...)`-style cast;
+  * D3 — true division `/` touching a tainted value (floats have no place
+    in lane math; `//` is what integer code means).
+
+Plus module-wide (host packers included, since their arrays feed the
+device layout):
+
+  * D2 — array constructors (`zeros`/`ones`/`empty`/`full`/`arange`/
+    `fromiter`/`frombuffer`/`array` on numpy or jax.numpy) without an
+    explicit dtype: default dtypes (float64 / platform int) are exactly
+    the drift this rule exists to stop.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, Optional, Sequence, Set, Tuple
+
+from phant_tpu.analysis.core import Finding, Rule, iter_calls
+from phant_tpu.analysis.rules._taint import Taint, resolve_external, snippet
+from phant_tpu.analysis.symbols import FunctionInfo, ModuleInfo, Project, _dotted
+
+DEFAULT_MODULES: Tuple[str, ...] = (
+    "phant_tpu.ops.keccak_jax",
+    "phant_tpu.ops.secp256k1_jax",
+    "phant_tpu.ops.witness_jax",
+)
+
+_INT32_MAX = 2**31 - 1
+
+_DTYPE_NAMES = {
+    "uint8",
+    "uint16",
+    "uint32",
+    "uint64",
+    "int8",
+    "int16",
+    "int32",
+    "int64",
+    "float32",
+    "float64",
+    "bfloat16",
+}
+
+#: constructor -> index of the positional dtype slot (None = keyword only)
+_CREATORS: Dict[str, Optional[int]] = {
+    "zeros": 1,
+    "ones": 1,
+    "empty": 1,
+    "full": 2,
+    "fromiter": 1,
+    "frombuffer": 1,
+    "array": 1,
+    "arange": None,
+}
+
+
+def _is_cast_call(mi: ModuleInfo, call: ast.Call) -> bool:
+    """jnp.uint32(x) / np.int64(x) / jnp.asarray(x, dtype=...) / x.astype."""
+    func = call.func
+    if isinstance(func, ast.Attribute) and func.attr == "astype":
+        return True
+    d = _dotted(func)
+    if d is None:
+        return False
+    full = resolve_external(mi, d)
+    if full.startswith(("numpy.", "jax.numpy.")):
+        leaf = full.rsplit(".", 1)[1]
+        if leaf in _DTYPE_NAMES:
+            return True
+        if leaf in ("asarray", "array") and any(
+            kw.arg == "dtype" for kw in call.keywords
+        ):
+            return True
+    return False
+
+
+def _dtype_expr(mi: ModuleInfo, node: ast.AST) -> bool:
+    """Does this expression denote a dtype (np.uint32, "…", bool, int)?"""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return True
+    if isinstance(node, ast.Name) and node.id in ("bool", "int", "float"):
+        return True
+    d = _dotted(node)
+    if d is None:
+        return False
+    full = resolve_external(mi, d)
+    return (
+        full.startswith(("numpy.", "jax.numpy."))
+        and full.rsplit(".", 1)[1] in _DTYPE_NAMES
+    )
+
+
+class DTypeRule(Rule):
+    name = "DTYPE"
+    description = "implicit dtype promotion in uint32 lane-math modules"
+
+    def __init__(self, modules: Sequence[str] = DEFAULT_MODULES):
+        self.scope = tuple(modules)
+
+    def run(self, project: Project) -> Iterator[Finding]:
+        scoped = [project.modules[m] for m in self.scope if m in project.modules]
+        lane = self._lane_functions(project, scoped)
+        for mi in scoped:
+            # D2 covers the whole module (host packers + module constants)
+            yield from self._check_creators(project, mi)
+            funcs = list(mi.functions.values())
+            for ci in mi.classes.values():
+                funcs.extend(ci.methods.values())
+            for fi in funcs:
+                yield from self._check_function(project, mi, fi, lane)
+
+    def _lane_functions(self, project: Project, scoped) -> Set[str]:
+        """jitted functions in scope + their transitive callees in scope."""
+        entries = []
+        in_scope = set()
+        for mi in scoped:
+            for fi in mi.functions.values():
+                in_scope.add(fi.qualname)
+                if fi.jitted:
+                    entries.append(fi.qualname)
+            for ci in mi.classes.values():
+                for fi in ci.methods.values():
+                    in_scope.add(fi.qualname)
+                    if fi.jitted:
+                        entries.append(fi.qualname)
+        return project.reachable(entries) & in_scope
+
+    def _check_function(
+        self,
+        project: Project,
+        mi: ModuleInfo,
+        fi: FunctionInfo,
+        lane: Set[str],
+    ) -> Iterator[Finding]:
+        is_lane = fi.qualname in lane
+        taint = Taint(project, mi, fi.node, taint_params=is_lane)
+        casted: Set[int] = set()  # id() of literal nodes under a cast
+        for call in iter_calls(fi.node):
+            if _is_cast_call(mi, call):
+                for a in call.args:
+                    casted.add(id(a))
+        if is_lane:
+            yield from self._check_lane(project, mi, fi, taint, casted)
+
+    def _check_lane(self, project, mi, fi, taint, casted) -> Iterator[Finding]:
+        def big_literal(node: ast.AST) -> bool:
+            return (
+                isinstance(node, ast.Constant)
+                and isinstance(node.value, int)
+                and not isinstance(node.value, bool)
+                and abs(node.value) > _INT32_MAX
+                and id(node) not in casted
+            )
+
+        for node in ast.walk(fi.node):
+            if isinstance(node, ast.BinOp):
+                if isinstance(node.op, ast.Div) and (
+                    taint.tainted(node.left) or taint.tainted(node.right)
+                ):
+                    yield self.finding(
+                        project,
+                        mi,
+                        node,
+                        f"true division `{snippet(node)}` promotes lane math "
+                        "to float — use // or an explicit cast",
+                        context=fi.qualname,
+                    )
+                for lit, other in (
+                    (node.left, node.right),
+                    (node.right, node.left),
+                ):
+                    if big_literal(lit) and taint.tainted(other):
+                        yield self.finding(
+                            project,
+                            mi,
+                            lit,
+                            f"int literal {getattr(lit, 'value', '?'):#x} "
+                            "does not fit int32; mixing it into lane math "
+                            "without jnp.uint32(...) promotes or overflows",
+                            context=fi.qualname,
+                        )
+            elif isinstance(node, ast.Call):
+                func = node.func
+                recv_tainted = False
+                if isinstance(func, ast.Attribute):
+                    recv_tainted = taint.tainted(func.value)
+                any_tainted = recv_tainted or any(
+                    taint.tainted(a) for a in node.args
+                )
+                if not any_tainted:
+                    continue
+                for a in node.args:
+                    if big_literal(a):
+                        yield self.finding(
+                            project,
+                            mi,
+                            a,
+                            f"int literal {a.value:#x} does not fit int32; "
+                            f"passing it uncast into `{snippet(node)}` "
+                            "promotes or overflows the lane dtype",
+                            context=fi.qualname,
+                        )
+
+    def _check_creators(self, project, mi) -> Iterator[Finding]:
+        for call in iter_calls(mi.tree):
+            d = _dotted(call.func)
+            if d is None:
+                continue
+            full = resolve_external(mi, d)
+            if not full.startswith(("numpy.", "jax.numpy.")):
+                continue
+            leaf = full.rsplit(".", 1)[1]
+            if leaf not in _CREATORS:
+                continue
+            if any(kw.arg == "dtype" for kw in call.keywords):
+                continue
+            slot = _CREATORS[leaf]
+            if slot is not None and len(call.args) > slot:
+                continue
+            if leaf == "arange" and any(
+                _dtype_expr(mi, a) for a in call.args
+            ):
+                continue
+            yield self.finding(
+                project,
+                mi,
+                call,
+                f"`{snippet(call)}` creates an array without an explicit "
+                "dtype in a lane-math module (default dtype drifts by "
+                "platform)",
+                context=mi.name,
+            )

@@ -1,0 +1,126 @@
+"""HOSTSYNC: accidental device->host syncs on the verification hot path.
+
+The north-star loop (batched keccak over witness nodes, post-state roots,
+vmapped ecrecover) only sustains its throughput while the device pipeline
+stays asynchronous: a stray `.item()`, `int(device_value)` or
+`np.asarray(device_value)` inside the hot path forces a blocking
+round-trip per call — invisible in review, catastrophic in the profiler
+(the exact failure mode MHOT's hash-pipeline analysis warns about).
+
+Scope: every function reachable (phant_tpu/analysis/symbols.py call
+graph) from the hot-path entry points — `stateless.execute_stateless`
+and `WitnessEngine.verify_batch` by default. Flags:
+
+  * `.item()` calls (always — a scalar pull is a sync no matter the type);
+  * `.block_until_ready()` calls (an explicit sync; legitimate ones are
+    probes/benchmarks and carry a disable annotation with the reason);
+  * `jax.device_get(...)`;
+  * `int()` / `bool()` / `float()` / `np.asarray()` / `np.array()` over a
+    device-tainted expression (see rules/_taint.py).
+
+Intentional syncs — the timed `keccak.host_readback` phase, the one-shot
+link probe — are annotated `# phantlint: disable=HOSTSYNC` with a reason,
+which doubles as in-code documentation of where the honest syncs live.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, Sequence, Tuple
+
+from phant_tpu.analysis.core import Finding, Rule, iter_calls
+from phant_tpu.analysis.rules._taint import (
+    Taint,
+    is_jax_call,
+    resolve_external,
+    snippet,
+)
+from phant_tpu.analysis.symbols import Project, _dotted
+
+DEFAULT_ENTRIES: Tuple[str, ...] = (
+    "phant_tpu.stateless.execute_stateless",
+    "phant_tpu.ops.witness_engine.WitnessEngine.verify_batch",
+)
+
+_SCALAR_BUILTINS = ("int", "bool", "float")
+
+
+class HostSyncRule(Rule):
+    name = "HOSTSYNC"
+    description = "device->host sync inside the hot verification path"
+
+    def __init__(self, entries: Sequence[str] = DEFAULT_ENTRIES):
+        self.entries = tuple(entries)
+
+    def run(self, project: Project) -> Iterator[Finding]:
+        for qualname in sorted(project.reachable(self.entries)):
+            fi = project.functions.get(qualname)
+            if fi is None:
+                continue
+            mi = project.modules.get(fi.module)
+            if mi is None:
+                continue
+            taint = Taint(project, mi, fi.node, taint_params=fi.jitted)
+            for call in iter_calls(fi.node):
+                func = call.func
+                if isinstance(func, ast.Attribute):
+                    if func.attr == "item" and not call.args:
+                        yield self.finding(
+                            project,
+                            mi,
+                            call,
+                            f"`{snippet(call)}` forces a device->host sync "
+                            "(.item()) on the hot path",
+                            context=qualname,
+                        )
+                        continue
+                    if func.attr == "block_until_ready":
+                        yield self.finding(
+                            project,
+                            mi,
+                            call,
+                            f"`{snippet(call)}` blocks on device completion "
+                            "on the hot path",
+                            context=qualname,
+                        )
+                        continue
+                d = _dotted(func)
+                if d is not None:
+                    full = resolve_external(mi, d)
+                    if full == "jax.device_get":
+                        yield self.finding(
+                            project,
+                            mi,
+                            call,
+                            f"`{snippet(call)}` copies a device value to "
+                            "host on the hot path",
+                            context=qualname,
+                        )
+                        continue
+                    if full in ("numpy.asarray", "numpy.array") and any(
+                        taint.tainted(a) for a in call.args
+                    ):
+                        yield self.finding(
+                            project,
+                            mi,
+                            call,
+                            f"`{snippet(call)}` materializes a device value "
+                            "on host (blocking readback) on the hot path",
+                            context=qualname,
+                        )
+                        continue
+                if (
+                    isinstance(func, ast.Name)
+                    and func.id in _SCALAR_BUILTINS
+                    and func.id not in mi.imports
+                    and func.id not in mi.functions
+                    and any(taint.tainted(a) for a in call.args)
+                ):
+                    yield self.finding(
+                        project,
+                        mi,
+                        call,
+                        f"`{snippet(call)}` pulls a device scalar to host "
+                        f"({func.id}() is a blocking sync) on the hot path",
+                        context=qualname,
+                    )

@@ -1,0 +1,213 @@
+"""JITHYGIENE: jit boundary mistakes that compile-and-misbehave.
+
+jax.jit failures split into loud (tracing errors) and quiet (a cache that
+never hits, a closure that captures stale state). This rule catches both
+classes statically, on every `@jax.jit` / `functools.partial(jax.jit,…)`
+function and every `name = jax.jit(f)`-style module-level wrapping:
+
+  * J1 — `static_argnames` naming a parameter that does not exist: jax
+    silently ignores unknown names (the arg traces instead of
+    specializing, so every distinct value retraces… or worse, doesn't).
+  * J2 — a jitted parameter with a mutable default (list/dict/set): the
+    default is unhashable as a static and a shared mutable across traces
+    otherwise.
+  * J3 — a parameter used where tracing needs a Python value — `range()`,
+    a shape argument (`zeros`/`full`/`reshape`/`broadcast_to`/`arange`),
+    or an `if`/`while` test — without being in `static_argnames`
+    (`.shape`/`.ndim`/`.dtype` attribute reads are static and exempt).
+  * J4 — jitted code (or an intra-module helper it calls) reading a
+    module-level mutable literal (list/dict/set): the first trace bakes
+    the value in; later mutation is silently ignored. Constant tables
+    belong in tuples or arrays.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List, Set
+
+from phant_tpu.analysis.core import Finding, Rule
+from phant_tpu.analysis.symbols import (
+    FunctionInfo,
+    ModuleInfo,
+    Project,
+    _dotted,
+)
+
+_SHAPE_CALLS = {
+    "zeros",
+    "ones",
+    "empty",
+    "full",
+    "arange",
+    "reshape",
+    "broadcast_to",
+}
+_STATIC_ATTRS = {"shape", "ndim", "dtype", "size"}
+
+
+def _param_names(fn: ast.AST) -> List[str]:
+    a = fn.args
+    names = [p.arg for p in list(a.posonlyargs) + list(a.args) + list(a.kwonlyargs)]
+    if a.vararg:
+        names.append(a.vararg.arg)
+    if a.kwarg:
+        names.append(a.kwarg.arg)
+    return names
+
+
+class JitHygieneRule(Rule):
+    name = "JITHYGIENE"
+    description = "jit static/closure hygiene on device entry points"
+
+    def run(self, project: Project) -> Iterator[Finding]:
+        for mi in project.modules.values():
+            funcs = list(mi.functions.values())
+            for ci in mi.classes.values():
+                funcs.extend(ci.methods.values())
+            jitted = [fi for fi in funcs if fi.jitted]
+            for fi in jitted:
+                yield from self._check_signature(project, mi, fi)
+                yield from self._check_traced_usage(project, mi, fi)
+            if jitted:
+                yield from self._check_mutable_globals(project, mi, jitted)
+
+    # -- J1 / J2 -------------------------------------------------------------
+
+    def _check_signature(self, project, mi, fi) -> Iterator[Finding]:
+        params = set(_param_names(fi.node))
+        for name in fi.static_argnames:
+            if name not in params:
+                yield self.finding(
+                    project,
+                    mi,
+                    fi.node,
+                    f"static_argnames={name!r} does not match any parameter "
+                    f"of {fi.node.name}() — jax ignores it and the argument "
+                    "traces instead of specializing",
+                    context=fi.qualname,
+                )
+        a = fi.node.args
+        defaults = list(a.defaults) + [d for d in a.kw_defaults if d is not None]
+        for d in defaults:
+            if isinstance(d, (ast.List, ast.Dict, ast.Set)):
+                yield self.finding(
+                    project,
+                    mi,
+                    d,
+                    f"jitted function {fi.node.name}() has a mutable default "
+                    "argument — unhashable as a static, shared across traces "
+                    "otherwise",
+                    context=fi.qualname,
+                )
+
+    # -- J3 ------------------------------------------------------------------
+
+    def _check_traced_usage(self, project, mi, fi) -> Iterator[Finding]:
+        traced = set(_param_names(fi.node)) - set(fi.static_argnames)
+        if not traced:
+            return
+        parents: Dict[int, ast.AST] = {}
+        for node in ast.walk(fi.node):
+            for child in ast.iter_child_nodes(node):
+                parents[id(child)] = node
+
+        def names_in(node: ast.AST) -> Set[str]:
+            """Traced params referenced in node, minus static .shape reads."""
+            out: Set[str] = set()
+            for n in ast.walk(node):
+                if isinstance(n, ast.Name) and n.id in traced:
+                    p = parents.get(id(n))
+                    if (
+                        isinstance(p, ast.Attribute)
+                        and p.attr in _STATIC_ATTRS
+                        and p.value is n
+                    ):
+                        continue
+                    out.add(n.id)
+            return out
+
+        reported: Set[str] = set()
+
+        def report(node, names, how):
+            for name in sorted(names - reported):
+                reported.add(name)
+                yield self.finding(
+                    project,
+                    mi,
+                    node,
+                    f"traced parameter `{name}` of {fi.node.name}() is used "
+                    f"as a Python value ({how}) — add it to static_argnames "
+                    "or hoist it out of the jitted function",
+                    context=fi.qualname,
+                )
+
+        for node in ast.walk(fi.node):
+            if isinstance(node, ast.Call):
+                func = node.func
+                if isinstance(func, ast.Name) and func.id == "range":
+                    yield from report(
+                        node, set().union(*(names_in(a) for a in node.args)),
+                        "range() bound",
+                    )
+                else:
+                    d = _dotted(func)
+                    leaf = d.rsplit(".", 1)[-1] if d else (
+                        func.attr if isinstance(func, ast.Attribute) else None
+                    )
+                    if leaf in _SHAPE_CALLS and node.args:
+                        shape_args = node.args[:1]
+                        yield from report(
+                            node,
+                            set().union(*(names_in(a) for a in shape_args)),
+                            f"shape argument of {leaf}()",
+                        )
+            elif isinstance(node, (ast.If, ast.While)):
+                yield from report(
+                    node.test, names_in(node.test), "if/while test"
+                )
+
+    # -- J4 ------------------------------------------------------------------
+
+    def _check_mutable_globals(
+        self, project: Project, mi: ModuleInfo, jitted: List[FunctionInfo]
+    ) -> Iterator[Finding]:
+        # intra-module closure: jitted functions + their callees in-module
+        in_module = {
+            fi.qualname
+            for fi in list(mi.functions.values())
+            + [m for c in mi.classes.values() for m in c.methods.values()]
+        }
+        closure = project.reachable([fi.qualname for fi in jitted]) & in_module
+        for qualname in sorted(closure):
+            fi = project.functions[qualname]
+            local_names = set(_param_names(fi.node))
+            for node in ast.walk(fi.node):
+                if isinstance(node, ast.Name) and isinstance(
+                    node.ctx, (ast.Store, ast.Del)
+                ):
+                    local_names.add(node.id)
+            for node in ast.walk(fi.node):
+                if not (isinstance(node, ast.Name) and isinstance(node.ctx, ast.Load)):
+                    continue
+                if node.id in local_names:
+                    continue
+                lineno = mi.mutable_globals.get(node.id)
+                origin = mi.name
+                if lineno is None and node.id in mi.imports:
+                    target = mi.imports[node.id]
+                    omod, _, oname = target.rpartition(".")
+                    other = project.modules.get(omod)
+                    if other is not None and oname in other.mutable_globals:
+                        lineno, origin = other.mutable_globals[oname], omod
+                if lineno is None:
+                    continue
+                yield self.finding(
+                    project,
+                    mi,
+                    node,
+                    f"jit-reachable code reads module-level mutable "
+                    f"`{node.id}` ({origin}:{lineno}) — the first trace "
+                    "bakes it in; use a tuple/array constant",
+                    context=qualname,
+                )

@@ -1,0 +1,333 @@
+"""LOCK: attributes guarded by a threading.Lock read/written outside it.
+
+Two checks, both tuned to this codebase's concurrency shape (Engine API
+handler threads over shared `WitnessEngine` / `Metrics` state):
+
+L1 — class-level lock discipline. For every class whose `__init__` creates
+`self.<lock> = threading.Lock()/RLock()`, an attribute is *guarded* once
+any method touches it inside `with self.<lock>:`. Every other touch of a
+guarded attribute must also hold the lock, except:
+
+  * `__init__` itself (construction is single-threaded by contract);
+  * methods named `*_locked` — the documented "caller holds the lock"
+    convention (`_verify_batch_locked`, `_stats_snapshot_locked`);
+  * private methods whose every intra-class call site is lock-held
+    (computed to a fixed point) — helpers of the locked region.
+
+  Public methods are always treated as entry points: a public method that
+  touches guarded state unlocked is a finding even if today's only caller
+  holds the lock, because nothing stops tomorrow's caller.
+
+  `outer = self` aliasing (the nested request-handler-class idiom in
+  engine_api/server.py) is resolved: `with outer._lock:` guards
+  `outer.attr` exactly like `self`.
+
+L2 — unlocked lazy init of module globals. The `global X; if X is None:
+X = …` memo pattern without a lock lets two threads initialize
+concurrently: usually double work, occasionally torn state (a probe
+result and its failure-backoff deadline disagreeing). Flagged whenever
+the writing function also tests the global and the assignment is not
+inside a `with <…lock…>:` block.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass
+from typing import Dict, Iterator, List, Optional, Set, Tuple
+
+from phant_tpu.analysis.core import Finding, Rule
+from phant_tpu.analysis.symbols import ClassInfo, ModuleInfo, Project, _dotted
+
+_LOCK_CTORS = ("threading.Lock", "threading.RLock")
+
+
+@dataclass
+class _Access:
+    method: str  # name of the (possibly nested) enclosing function
+    attr: str
+    node: ast.AST
+    locked: bool
+    is_call: bool  # base.attr(...) method call
+
+
+class LockRule(Rule):
+    name = "LOCK"
+    description = "lock-guarded state touched without the lock"
+
+    def run(self, project: Project) -> Iterator[Finding]:
+        for mi in project.modules.values():
+            for ci in mi.classes.values():
+                yield from self._check_class(project, mi, ci)
+            yield from self._check_lazy_init(project, mi)
+
+    # -- L1 ------------------------------------------------------------------
+
+    def _lock_attrs(self, mi: ModuleInfo, ci: ClassInfo) -> Set[str]:
+        init = ci.methods.get("__init__")
+        if init is None:
+            return set()
+        out: Set[str] = set()
+        for node in ast.walk(init.node):
+            if not (isinstance(node, ast.Assign) and isinstance(node.value, ast.Call)):
+                continue
+            d = _dotted(node.value.func)
+            if d is None:
+                continue
+            head, _, rest = d.partition(".")
+            full = mi.imports.get(head, head) + ("." + rest if rest else "")
+            if full not in _LOCK_CTORS and d not in _LOCK_CTORS:
+                continue
+            for tgt in node.targets:
+                if (
+                    isinstance(tgt, ast.Attribute)
+                    and isinstance(tgt.value, ast.Name)
+                    and tgt.value.id == "self"
+                ):
+                    out.add(tgt.attr)
+        return out
+
+    def _self_aliases(self, ci: ClassInfo) -> Set[str]:
+        names = {"self"}
+        init = ci.methods.get("__init__")
+        if init is not None:
+            for node in ast.walk(init.node):
+                if (
+                    isinstance(node, ast.Assign)
+                    and isinstance(node.value, ast.Name)
+                    and node.value.id == "self"
+                ):
+                    for tgt in node.targets:
+                        if isinstance(tgt, ast.Name):
+                            names.add(tgt.id)
+        return names
+
+    def _collect(
+        self,
+        method_name: str,
+        body: List[ast.stmt],
+        bases: Set[str],
+        locks: Set[str],
+        accesses: List[_Access],
+        calls: List[Tuple[str, str, bool]],  # (method, callee, locked)
+        locked: bool,
+        func_name: Optional[str] = None,
+    ) -> None:
+        """Recursive walk tracking with-lock context. Nested defs/classes
+        are attributed to their own (inner) function name so __init__'s
+        exemption does not leak to handler classes defined inside it."""
+        current = func_name or method_name
+        for stmt in body:
+            self._collect_stmt(current, stmt, bases, locks, accesses, calls, locked)
+
+    def _is_lock_ctx(self, item: ast.withitem, bases: Set[str], locks: Set[str]) -> bool:
+        e = item.context_expr
+        return (
+            isinstance(e, ast.Attribute)
+            and isinstance(e.value, ast.Name)
+            and e.value.id in bases
+            and e.attr in locks
+        )
+
+    def _collect_stmt(self, current, stmt, bases, locks, accesses, calls, locked):
+        if isinstance(stmt, ast.With):
+            inner = locked or any(
+                self._is_lock_ctx(i, bases, locks) for i in stmt.items
+            )
+            for i in stmt.items:
+                self._collect_expr(current, i.context_expr, bases, locks, accesses, calls, locked)
+            for s in stmt.body:
+                self._collect_stmt(current, s, bases, locks, accesses, calls, inner)
+            return
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for s in stmt.body:
+                self._collect_stmt(stmt.name, s, bases, locks, accesses, calls, locked)
+            return
+        if isinstance(stmt, ast.ClassDef):
+            for s in stmt.body:
+                self._collect_stmt(current, s, bases, locks, accesses, calls, locked)
+            return
+        for child in ast.iter_child_nodes(stmt):
+            if isinstance(child, ast.stmt):
+                self._collect_stmt(current, child, bases, locks, accesses, calls, locked)
+            elif isinstance(child, ast.expr):
+                self._collect_expr(current, child, bases, locks, accesses, calls, locked)
+            elif isinstance(child, ast.ExceptHandler):
+                # except blocks are where races hide (error paths); their
+                # bodies are neither stmt nor expr and must not be skipped
+                for s in child.body:
+                    self._collect_stmt(current, s, bases, locks, accesses, calls, locked)
+            elif isinstance(child, getattr(ast, "match_case", ())):
+                # match-case bodies are the same kind of non-stmt container
+                if child.guard is not None:
+                    self._collect_expr(current, child.guard, bases, locks, accesses, calls, locked)
+                for s in child.body:
+                    self._collect_stmt(current, s, bases, locks, accesses, calls, locked)
+
+    def _collect_expr(self, current, expr, bases, locks, accesses, calls, locked):
+        for node in ast.walk(expr):
+            if isinstance(node, ast.Call):
+                f = node.func
+                if (
+                    isinstance(f, ast.Attribute)
+                    and isinstance(f.value, ast.Name)
+                    and f.value.id in bases
+                ):
+                    calls.append((current, f.attr, locked))
+            if (
+                isinstance(node, ast.Attribute)
+                and isinstance(node.value, ast.Name)
+                and node.value.id in bases
+                and node.attr not in locks
+            ):
+                accesses.append(
+                    _Access(
+                        method=current,
+                        attr=node.attr,
+                        node=node,
+                        locked=locked,
+                        is_call=False,
+                    )
+                )
+
+    def _check_class(
+        self, project: Project, mi: ModuleInfo, ci: ClassInfo
+    ) -> Iterator[Finding]:
+        locks = self._lock_attrs(mi, ci)
+        if not locks:
+            return
+        bases = self._self_aliases(ci)
+        accesses: List[_Access] = []
+        calls: List[Tuple[str, str, bool]] = []
+        for name, fi in ci.methods.items():
+            self._collect(name, fi.node.body, bases, locks, accesses, calls, False)
+        method_names = set(ci.methods)
+        # nested defs (handler-class idiom): their names are methods, not
+        # data attributes, and they participate in the lock fixed point
+        for fi in ci.methods.values():
+            for n in ast.walk(fi.node):
+                if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    method_names.add(n.name)
+        nested_methods = {a.method for a in accesses} | {c[0] for c in calls}
+        data_accesses = [a for a in accesses if a.attr not in method_names]
+        guarded = {a.attr for a in data_accesses if a.locked}
+        if not guarded:
+            return
+        # fixed point: private helpers whose every call site holds the lock
+        lock_required: Set[str] = {
+            m for m in (method_names | nested_methods) if m.endswith("_locked")
+        }
+        changed = True
+        while changed:
+            changed = False
+            for m in method_names | nested_methods:
+                if m in lock_required or not m.startswith("_") or m == "__init__":
+                    continue
+                sites = [c for c in calls if c[1] == m]
+                if sites and all(
+                    locked_ or caller in lock_required
+                    for caller, _, locked_ in sites
+                ):
+                    lock_required.add(m)
+                    changed = True
+        for a in data_accesses:
+            if a.locked or a.attr not in guarded:
+                continue
+            if a.method == "__init__" or a.method in lock_required:
+                continue
+            yield self.finding(
+                project,
+                mi,
+                a.node,
+                f"`{ci.node.name}.{a.attr}` is guarded by "
+                f"`{sorted(locks)[0]}` elsewhere but touched without it in "
+                f"{a.method}() — take the lock or move the access into a "
+                "*_locked helper",
+                context=f"{ci.qualname}.{a.method}",
+            )
+
+    # -- L2 ------------------------------------------------------------------
+
+    def _check_lazy_init(self, project: Project, mi: ModuleInfo) -> Iterator[Finding]:
+        funcs = list(mi.functions.values())
+        for ci in mi.classes.values():
+            funcs.extend(ci.methods.values())
+        for fi in funcs:
+            if fi.node.name.endswith("_locked"):
+                continue  # documented "caller holds the lock" convention
+            globals_declared: Set[str] = set()
+            for node in ast.walk(fi.node):
+                if isinstance(node, ast.Global):
+                    globals_declared.update(node.names)
+            if not globals_declared:
+                continue
+            tested = self._tested_globals(fi.node, globals_declared)
+            if not tested:
+                continue
+            for name, node in self._unlocked_stores(fi.node, tested):
+                yield self.finding(
+                    project,
+                    mi,
+                    node,
+                    f"lazy init of module global `{name}` in "
+                    f"{fi.node.name}() is not under a lock — concurrent "
+                    "callers race the memo (double init / torn state)",
+                    context=fi.qualname,
+                )
+
+    @staticmethod
+    def _tested_globals(fn: ast.AST, names: Set[str]) -> Set[str]:
+        out: Set[str] = set()
+        for node in ast.walk(fn):
+            if isinstance(node, (ast.If, ast.While)):
+                for n in ast.walk(node.test):
+                    if isinstance(n, ast.Name) and n.id in names:
+                        out.add(n.id)
+        return out
+
+    def _unlocked_stores(self, fn: ast.AST, names: Set[str]):
+        """(name, node) for the FIRST assignment to each of `names` outside
+        any with-lock block (one finding per global per function)."""
+        seen: Set[str] = set()
+
+        def walk(stmts, locked):
+            for stmt in stmts:
+                if isinstance(stmt, ast.With):
+                    inner = locked or any(
+                        "lock" in (_dotted(i.context_expr) or "").lower()
+                        for i in stmt.items
+                    )
+                    yield from walk(stmt.body, inner)
+                    continue
+                if isinstance(
+                    stmt, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)
+                ):
+                    continue  # nested scopes declare their own globals
+                if not locked and isinstance(
+                    stmt, (ast.Assign, ast.AugAssign, ast.AnnAssign)
+                ):
+                    targets = (
+                        stmt.targets
+                        if isinstance(stmt, ast.Assign)
+                        else [stmt.target]
+                    )
+                    for tgt in targets:
+                        for n in ast.walk(tgt):
+                            if (
+                                isinstance(n, ast.Name)
+                                and isinstance(n.ctx, ast.Store)
+                                and n.id in names
+                                and n.id not in seen
+                            ):
+                                seen.add(n.id)
+                                yield n.id, stmt
+                for attr in ("body", "orelse", "finalbody"):
+                    part = getattr(stmt, attr, None)
+                    if isinstance(part, list) and part and isinstance(
+                        part[0], ast.stmt
+                    ):
+                        yield from walk(part, locked)
+                for h in getattr(stmt, "handlers", []) or []:
+                    yield from walk(h.body, locked)
+
+        yield from walk(fn.body, False)

@@ -1,0 +1,403 @@
+"""Lightweight module/symbol table + call graph for phantlint.
+
+Parses a package with `ast` (no imports are executed — the analyzer must
+run without jax so the commit gate stays cheap) and resolves just enough
+structure for the rules:
+
+  * per-module tables of top-level functions, classes (methods + resolved
+    bases), and import aliases (collected at EVERY scope — this codebase
+    imports heavily inside function bodies to keep jax off cold paths);
+  * a best-effort call graph over project-global qualnames
+    ("pkg.mod.func", "pkg.mod.Class.method") covering: direct calls of
+    local/imported functions, `self.method()` (with base-class walk),
+    `super().method()`, constructor calls, `alias.func()` module-attribute
+    calls, and `var.method()` where `var` was assigned from a known
+    constructor in the same function;
+  * jit detection: `@jax.jit`, `@functools.partial(jax.jit, ...)`
+    decorators and `name = jax.jit(f)` / `partial(jax.jit, ...)(f)`
+    module-level assignments, with their `static_argnames`.
+
+Deliberately NOT a type checker: calls through attributes of unknown
+objects (`self.signer.recover(...)`) resolve to nothing and reachability
+under-approximates there. Rules are written so under-approximation can
+only suppress findings, never invent them.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+
+@dataclass
+class FunctionInfo:
+    qualname: str  # project-global, e.g. "phant_tpu.stateless.execute_stateless"
+    module: str
+    node: ast.AST  # FunctionDef | AsyncFunctionDef
+    cls: Optional[str] = None  # owning class name (module-local), if a method
+    jitted: bool = False
+    static_argnames: Tuple[str, ...] = ()
+
+
+@dataclass
+class ClassInfo:
+    qualname: str
+    module: str
+    node: ast.ClassDef
+    methods: Dict[str, FunctionInfo] = field(default_factory=dict)
+    base_names: Tuple[str, ...] = ()  # unresolved (module-local) base names
+
+
+@dataclass
+class ModuleInfo:
+    name: str  # dotted module name
+    path: Path
+    tree: ast.Module
+    source: str
+    lines: List[str]
+    functions: Dict[str, FunctionInfo] = field(default_factory=dict)  # local name
+    classes: Dict[str, ClassInfo] = field(default_factory=dict)
+    imports: Dict[str, str] = field(default_factory=dict)  # alias -> dotted target
+    # module-level names assigned a mutable literal (list/dict/set display)
+    mutable_globals: Dict[str, int] = field(default_factory=dict)  # name -> lineno
+
+
+def _dotted(node: ast.AST) -> Optional[str]:
+    """Reconstruct a dotted name from Name/Attribute chains, else None."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _is_jax_jit(node: ast.AST, imports: Dict[str, str]) -> bool:
+    """Does this expression denote jax.jit (through any import alias)?"""
+    d = _dotted(node)
+    if d is None:
+        return False
+    # resolve the leading alias
+    head, _, rest = d.partition(".")
+    target = imports.get(head, head)
+    full = target + ("." + rest if rest else "")
+    return full in ("jax.jit", "jax.jit.jit")
+
+
+def _is_partial(node: ast.AST, imports: Dict[str, str]) -> bool:
+    d = _dotted(node)
+    if d is None:
+        return False
+    head, _, rest = d.partition(".")
+    target = imports.get(head, head)
+    full = target + ("." + rest if rest else "")
+    return full in ("functools.partial", "partial")
+
+
+def _static_argnames_of(call: ast.Call) -> Tuple[str, ...]:
+    for kw in call.keywords:
+        if kw.arg == "static_argnames":
+            v = kw.value
+            if isinstance(v, ast.Constant) and isinstance(v.value, str):
+                return (v.value,)
+            if isinstance(v, (ast.Tuple, ast.List)):
+                out = []
+                for elt in v.elts:
+                    if isinstance(elt, ast.Constant) and isinstance(elt.value, str):
+                        out.append(elt.value)
+                return tuple(out)
+    return ()
+
+
+def _jit_of_decorators(
+    fn: ast.AST, imports: Dict[str, str]
+) -> Tuple[bool, Tuple[str, ...]]:
+    for dec in getattr(fn, "decorator_list", []):
+        if _is_jax_jit(dec, imports):
+            return True, ()
+        if isinstance(dec, ast.Call):
+            if _is_jax_jit(dec.func, imports):
+                return True, _static_argnames_of(dec)
+            # functools.partial(jax.jit, static_argnames=...)
+            if (
+                _is_partial(dec.func, imports)
+                and dec.args
+                and _is_jax_jit(dec.args[0], imports)
+            ):
+                return True, _static_argnames_of(dec)
+    return False, ()
+
+
+def parse_module(name: str, path: Path) -> Optional[ModuleInfo]:
+    try:
+        source = path.read_text()
+        tree = ast.parse(source, filename=str(path))
+    except (OSError, SyntaxError):
+        return None
+    mi = ModuleInfo(
+        name=name, path=path, tree=tree, source=source, lines=source.splitlines()
+    )
+    # imports at every scope
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                mi.imports[alias.asname or alias.name.split(".")[0]] = (
+                    alias.name if alias.asname else alias.name.split(".")[0]
+                )
+                if alias.asname:
+                    mi.imports[alias.asname] = alias.name
+        elif isinstance(node, ast.ImportFrom):
+            if node.level:  # relative import: resolve against this module
+                base = name.split(".")
+                base = base[: len(base) - node.level]
+                prefix = ".".join(base + ([node.module] if node.module else []))
+            else:
+                prefix = node.module or ""
+            for alias in node.names:
+                if alias.name == "*":
+                    continue
+                mi.imports[alias.asname or alias.name] = (
+                    f"{prefix}.{alias.name}" if prefix else alias.name
+                )
+    # top-level defs / classes / mutable globals
+    for node in tree.body:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            jit, statics = _jit_of_decorators(node, mi.imports)
+            mi.functions[node.name] = FunctionInfo(
+                qualname=f"{name}.{node.name}",
+                module=name,
+                node=node,
+                jitted=jit,
+                static_argnames=statics,
+            )
+        elif isinstance(node, ast.ClassDef):
+            ci = ClassInfo(
+                qualname=f"{name}.{node.name}",
+                module=name,
+                node=node,
+                base_names=tuple(
+                    b for b in (_dotted(base) for base in node.bases) if b
+                ),
+            )
+            for item in node.body:
+                if isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    jit, statics = _jit_of_decorators(item, mi.imports)
+                    ci.methods[item.name] = FunctionInfo(
+                        qualname=f"{name}.{node.name}.{item.name}",
+                        module=name,
+                        node=item,
+                        cls=node.name,
+                        jitted=jit,
+                        static_argnames=statics,
+                    )
+            mi.classes[node.name] = ci
+        elif isinstance(node, ast.Assign) and len(node.targets) == 1:
+            tgt = node.targets[0]
+            if isinstance(tgt, ast.Name):
+                if isinstance(node.value, (ast.List, ast.Dict, ast.Set)):
+                    mi.mutable_globals[tgt.id] = node.lineno
+                else:
+                    _maybe_assigned_jit(mi, tgt.id, node.value)
+    return mi
+
+
+def _maybe_assigned_jit(mi: ModuleInfo, name: str, value: ast.AST) -> None:
+    """`f = jax.jit(g)` / `f = functools.partial(jax.jit, ...)(g)`: mark g
+    (and register f as an alias of a jitted function)."""
+    if not isinstance(value, ast.Call):
+        return
+    inner: Optional[ast.AST] = None
+    statics: Tuple[str, ...] = ()
+    if _is_jax_jit(value.func, mi.imports) and value.args:
+        inner = value.args[0]
+        statics = _static_argnames_of(value)
+    elif (
+        isinstance(value.func, ast.Call)
+        and _is_partial(value.func.func, mi.imports)
+        and value.func.args
+        and _is_jax_jit(value.func.args[0], mi.imports)
+        and value.args
+    ):
+        inner = value.args[0]
+        statics = _static_argnames_of(value.func)
+    if inner is None:
+        return
+    d = _dotted(inner)
+    if d and d in mi.functions:
+        fi = mi.functions[d]
+        fi.jitted = True
+        fi.static_argnames = statics
+        # the wrapper name calls through to the same function
+        mi.imports.setdefault(name, fi.qualname)
+
+
+class Project:
+    """All parsed modules plus the resolved call graph."""
+
+    def __init__(self, modules: Dict[str, ModuleInfo]):
+        self.modules = modules
+        self.functions: Dict[str, FunctionInfo] = {}
+        self.classes: Dict[str, ClassInfo] = {}
+        for mi in modules.values():
+            for fi in mi.functions.values():
+                self.functions[fi.qualname] = fi
+            for ci in mi.classes.values():
+                self.classes[ci.qualname] = ci
+                for fi in ci.methods.values():
+                    self.functions[fi.qualname] = fi
+        self.call_graph: Dict[str, Set[str]] = {}
+        for mi in modules.values():
+            for fi in mi.functions.values():
+                self.call_graph[fi.qualname] = self._calls_of(mi, fi)
+            for ci in mi.classes.values():
+                for fi in ci.methods.values():
+                    self.call_graph[fi.qualname] = self._calls_of(mi, fi)
+
+    # -- name resolution ----------------------------------------------------
+
+    def resolve_name(self, module: str, dotted: str) -> Optional[str]:
+        """Module-local dotted name -> project-global qualname (function or
+        class), through import aliases; None for anything external."""
+        mi = self.modules.get(module)
+        if mi is None:
+            return None
+        head, _, rest = dotted.partition(".")
+        if not rest:
+            if head in mi.functions:
+                return mi.functions[head].qualname
+            if head in mi.classes:
+                return mi.classes[head].qualname
+            target = mi.imports.get(head)
+            if target is None:
+                return None
+            if target in self.functions or target in self.classes:
+                return target
+            return None
+        target = mi.imports.get(head)
+        if target is None:
+            return None
+        cand = f"{target}.{rest}"
+        if cand in self.functions or cand in self.classes:
+            return cand
+        return None
+
+    def resolve_class(self, module: str, dotted: str) -> Optional[ClassInfo]:
+        q = self.resolve_name(module, dotted)
+        return self.classes.get(q) if q else None
+
+    def method_of(self, ci: ClassInfo, name: str) -> Optional[FunctionInfo]:
+        """Method lookup with single-inheritance base walk."""
+        seen: Set[str] = set()
+        stack = [ci]
+        while stack:
+            c = stack.pop()
+            if c.qualname in seen:
+                continue
+            seen.add(c.qualname)
+            if name in c.methods:
+                return c.methods[name]
+            for b in c.base_names:
+                base = self.resolve_class(c.module, b)
+                if base is not None:
+                    stack.append(base)
+        return None
+
+    # -- call graph ---------------------------------------------------------
+
+    def _calls_of(self, mi: ModuleInfo, fi: FunctionInfo) -> Set[str]:
+        out: Set[str] = set()
+        owner = mi.classes.get(fi.cls) if fi.cls else None
+        # local vars assigned from known constructors: var -> ClassInfo
+        var_classes: Dict[str, ClassInfo] = {}
+        for node in ast.walk(fi.node):
+            if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+                d = _dotted(node.value.func)
+                if d is not None:
+                    ci = self.resolve_class(mi.name, d)
+                    if ci is not None:
+                        for tgt in node.targets:
+                            if isinstance(tgt, ast.Name):
+                                var_classes[tgt.id] = ci
+        for node in ast.walk(fi.node):
+            if not isinstance(node, ast.Call):
+                continue
+            func = node.func
+            # super().m(...)
+            if (
+                isinstance(func, ast.Attribute)
+                and isinstance(func.value, ast.Call)
+                and isinstance(func.value.func, ast.Name)
+                and func.value.func.id == "super"
+                and owner is not None
+            ):
+                for b in owner.base_names:
+                    base = self.resolve_class(mi.name, b)
+                    if base is not None:
+                        m = self.method_of(base, func.attr)
+                        if m is not None:
+                            out.add(m.qualname)
+                            break
+                continue
+            # self.m(...) / var.m(...)
+            if isinstance(func, ast.Attribute) and isinstance(func.value, ast.Name):
+                recv = func.value.id
+                if recv == "self" and owner is not None:
+                    m = self.method_of(owner, func.attr)
+                    if m is not None:
+                        out.add(m.qualname)
+                        continue
+                if recv in var_classes:
+                    m = self.method_of(var_classes[recv], func.attr)
+                    if m is not None:
+                        out.add(m.qualname)
+                        continue
+            d = _dotted(func)
+            if d is None:
+                continue
+            q = self.resolve_name(mi.name, d)
+            if q is None:
+                continue
+            if q in self.functions:
+                out.add(q)
+            elif q in self.classes:
+                ci = self.classes[q]
+                out.add(ci.qualname)  # constructor marker
+                m = self.method_of(ci, "__init__")
+                if m is not None:
+                    out.add(m.qualname)
+        return out
+
+    def reachable(self, entries: Sequence[str]) -> Set[str]:
+        """Transitive closure over the call graph. A class qualname entry
+        includes every method of the class (conservative)."""
+        seen: Set[str] = set()
+        stack: List[str] = []
+        for e in entries:
+            if e in self.functions or e in self.classes:
+                stack.append(e)
+        while stack:
+            q = stack.pop()
+            if q in seen:
+                continue
+            seen.add(q)
+            if q in self.classes:
+                for m in self.classes[q].methods.values():
+                    stack.append(m.qualname)
+                continue
+            for callee in self.call_graph.get(q, ()):
+                if callee not in seen:
+                    stack.append(callee)
+        return seen
+
+    def module_of(self, qualname: str) -> Optional[ModuleInfo]:
+        fi = self.functions.get(qualname)
+        if fi is not None:
+            return self.modules.get(fi.module)
+        ci = self.classes.get(qualname)
+        if ci is not None:
+            return self.modules.get(ci.module)
+        return None

@@ -26,6 +26,7 @@ from __future__ import annotations
 
 import hashlib
 import os
+import threading
 from typing import Optional, Tuple
 
 from phant_tpu.crypto import bls12_381 as bls
@@ -43,6 +44,7 @@ _DEV_TAU = (
 )
 
 _SETUP: Optional[Tuple[bls.G2Point, str]] = None
+_setup_lock = threading.Lock()
 
 
 def dev_tau() -> int:
@@ -59,19 +61,26 @@ def _load_setup() -> Tuple[bls.G2Point, str]:
     return bls.g2_mul(bls.G2_GEN, _DEV_TAU), "insecure-dev"
 
 
-def setup_g2_tau() -> bls.G2Point:
+def _setup() -> Tuple[bls.G2Point, str]:
+    """Lazy [tau]G2 memo, lock-serialized (phantlint LOCK): blob-carrying
+    payloads verify from Engine API handler threads, and the dev-mode
+    g2_mul fallback is expensive enough that a race means seconds of
+    duplicated pairing work."""
     global _SETUP
     if _SETUP is None:
-        _SETUP = _load_setup()
-    return _SETUP[0]
+        with _setup_lock:
+            if _SETUP is None:
+                _SETUP = _load_setup()
+    return _SETUP
+
+
+def setup_g2_tau() -> bls.G2Point:
+    return _setup()[0]
 
 
 def setup_source() -> str:
     """"operator" (real ceremony bytes supplied) or "insecure-dev"."""
-    global _SETUP
-    if _SETUP is None:
-        _SETUP = _load_setup()
-    return _SETUP[1]
+    return _setup()[1]
 
 
 def reset_setup_cache() -> None:

@@ -93,6 +93,16 @@ class EngineAPIServer:
         class Handler(_ObservableHandler):
             def do_POST(self) -> None:  # noqa: N802 (stdlib API)
                 t0 = time.perf_counter()
+                # Lock-discipline audit (phantlint LOCK, PR 2): the
+                # counter / in-flight gauge / latency-histogram updates
+                # here run OUTSIDE outer._lock on purpose — the registry
+                # has its own internal lock (trace.Metrics._lock), and
+                # holding the request lock across observability writes
+                # would serialize the very concurrency the in-flight gauge
+                # measures. phantlint's LOCK rule scopes to the lock-owning
+                # object's own attributes, so it (correctly) reports
+                # nothing here — this comment, not a disable annotation,
+                # is the audit record.
                 metrics.gauge_add("engine_api.inflight", 1)
                 try:
                     self._handle_post()

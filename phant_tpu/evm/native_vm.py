@@ -15,6 +15,7 @@ pure-Python interpreter when the toolchain is unavailable.
 from __future__ import annotations
 
 import ctypes as ct
+import threading
 from typing import Optional
 
 from phant_tpu.evm import gas as G
@@ -159,9 +160,22 @@ def _write32(dst, value: int) -> None:
 
 _lib = None
 _lib_failed = False
+_load_lock = threading.Lock()
 
 
 def _load():
+    global _lib, _lib_failed
+    if _lib is not None or _lib_failed:
+        return _lib
+    # lock-serialized (phantlint LOCK): two request threads racing the
+    # argtypes/restype setup would mutate shared ctypes function objects
+    # mid-call. Acquisition order is _load_lock -> native._lock (inside
+    # load_native); nothing takes them in reverse.
+    with _load_lock:
+        return _load_locked()
+
+
+def _load_locked():
     global _lib, _lib_failed
     if _lib is not None or _lib_failed:
         return _lib

@@ -20,6 +20,7 @@ from __future__ import annotations
 
 import json
 import os
+import threading
 from typing import List, Optional, Tuple
 
 from phant_tpu.crypto import bls12_381 as bls
@@ -73,13 +74,19 @@ def _load_discounts() -> Optional[Tuple[List[int], List[int]]]:
 
 _DISCOUNTS: Optional[Tuple[List[int], List[int]]] = None
 _DISCOUNTS_LOADED = False
+_discounts_lock = threading.Lock()
 
 
 def _discounts() -> Optional[Tuple[List[int], List[int]]]:
+    """Lazy discount-table load, lock-serialized (phantlint LOCK): the
+    LOADED flag and the table are two globals — an unserialized race can
+    publish the flag before the table is visible to another thread."""
     global _DISCOUNTS, _DISCOUNTS_LOADED
     if not _DISCOUNTS_LOADED:
-        _DISCOUNTS = _load_discounts()
-        _DISCOUNTS_LOADED = True
+        with _discounts_lock:
+            if not _DISCOUNTS_LOADED:
+                _DISCOUNTS = _load_discounts()
+                _DISCOUNTS_LOADED = True
     return _DISCOUNTS
 
 

@@ -17,9 +17,11 @@ alias).
 from __future__ import annotations
 
 import os
+import threading
 from pathlib import Path
 
 _configured = False
+_configure_lock = threading.Lock()
 
 
 def enable_compilation_cache() -> None:
@@ -30,15 +32,21 @@ def enable_compilation_cache() -> None:
         or os.environ.get("PHANT_NO_COMPILE_CACHE", "0") not in ("", "0")
     ):
         return
-    _configured = True
-    try:
-        import jax
+    # lock-serialized (phantlint LOCK): concurrent first-use from two
+    # request threads must not interleave the three jax.config.update
+    # calls (the config object is process-global)
+    with _configure_lock:
+        if _configured:
+            return
+        _configured = True
+        try:
+            import jax
 
-        default = Path(__file__).resolve().parents[2] / "build" / "jax_cache"
-        cache_dir = os.environ.get("PHANT_JAX_CACHE", str(default))
-        Path(cache_dir).mkdir(parents=True, exist_ok=True)
-        jax.config.update("jax_compilation_cache_dir", cache_dir)
-        jax.config.update("jax_persistent_cache_min_compile_time_secs", 2.0)
-        jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
-    except Exception:
-        pass  # older jax or read-only fs: kernels still work, just uncached
+            default = Path(__file__).resolve().parents[2] / "build" / "jax_cache"
+            cache_dir = os.environ.get("PHANT_JAX_CACHE", str(default))
+            Path(cache_dir).mkdir(parents=True, exist_ok=True)
+            jax.config.update("jax_compilation_cache_dir", cache_dir)
+            jax.config.update("jax_persistent_cache_min_compile_time_secs", 2.0)
+            jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+        except Exception:
+            pass  # older jax or read-only fs: still works, just uncached

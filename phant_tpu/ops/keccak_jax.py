@@ -29,14 +29,16 @@ from phant_tpu.crypto.keccak import RATE, _KECCAK_RC as _RC
 
 RATE_WORDS = RATE // 8  # 17 lanes absorbed per chunk
 
-# rotation offset for lane x+5y (same table as native/keccak.cc kRot)
-_ROT = [
+# rotation offset for lane x+5y (same table as native/keccak.cc kRot).
+# A tuple, not a list: this is traced into the jitted kernels, and a
+# mutable table read at trace time is a stale-closure hazard (JITHYGIENE)
+_ROT = (
     0, 1, 62, 28, 27,
     36, 44, 6, 55, 20,
     3, 10, 43, 25, 39,
     41, 45, 15, 21, 8,
     18, 2, 61, 56, 14,
-]
+)
 
 
 def _rotl64(lo, hi, r: int):

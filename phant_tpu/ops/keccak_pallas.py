@@ -25,6 +25,7 @@ this framework's addition per the north star (SURVEY §7.8a).
 from __future__ import annotations
 
 import functools
+import threading as _threading
 from typing import List
 
 import jax
@@ -187,6 +188,7 @@ def keccak256_chunked_pallas(
 
 
 _PALLAS_OK: bool | None = None
+_probe_lock = _threading.Lock()
 
 
 def pallas_available() -> bool:
@@ -194,22 +196,27 @@ def pallas_available() -> bool:
 
     Mosaic requires a real TPU (or the interpreter); on the CPU-mesh test
     backend callers fall back to the jnp kernel.  Probed once per process
-    with a tiny shape.
+    with a tiny shape, lock-serialized (phantlint LOCK) so concurrent
+    first dispatches don't both pay the Mosaic trial compile.
     """
     global _PALLAS_OK
     if _PALLAS_OK is None:
-        try:
-            import jax
+        with _probe_lock:
+            if _PALLAS_OK is not None:
+                return _PALLAS_OK
+            try:
+                import jax
 
-            if jax.default_backend() == "cpu" and not _INTERPRET:
+                if jax.default_backend() == "cpu" and not _INTERPRET:
+                    _PALLAS_OK = False
+                else:
+                    w = jnp.zeros((1, 1, 34), jnp.uint32)
+                    n = jnp.ones((1,), jnp.int32)
+                    # the probe VERIFIES the kernel runs — the block is the point
+                    keccak256_chunked_pallas(w, n, max_chunks=1).block_until_ready()  # phantlint: disable=HOSTSYNC — one-shot Mosaic probe
+                    _PALLAS_OK = True
+            except Exception:
                 _PALLAS_OK = False
-            else:
-                w = jnp.zeros((1, 1, 34), jnp.uint32)
-                n = jnp.ones((1,), jnp.int32)
-                keccak256_chunked_pallas(w, n, max_chunks=1).block_until_ready()
-                _PALLAS_OK = True
-        except Exception:
-            _PALLAS_OK = False
     return _PALLAS_OK
 
 

@@ -41,6 +41,17 @@ for f in "${DEVICE_GROUPS[@]}"; do CORE_IGNORES+=("--ignore=$f"); done
 start=$(date +%s)
 fail=0
 
+# Static analysis FIRST (phantlint: host-sync / dtype / jit-hygiene /
+# lock-discipline / metric-name hazards): pure ast, ~2s, and a red
+# finding fails the gate before any pytest process spends minutes
+# compiling kernels. `make sanitize` is the native-C++ counterpart gate.
+t0=$(date +%s)
+JAX_PLATFORMS=cpu python scripts/phantlint.py phant_tpu/ \
+  --baseline scripts/phantlint_baseline.json
+rc=$?
+echo "[check] group phantlint: rc=$rc in $(( $(date +%s) - t0 ))s"
+if [ "$rc" -ne 0 ]; then fail=1; fi
+
 run_group() {
   local name="$1"; shift
   local t0 t1 rc

@@ -1,130 +1,55 @@
 #!/usr/bin/env python
-"""Metric-name lint: every exported metric family must be well-formed.
+"""Metric-name lint — a thin shim over phantlint's METRICNAME rule.
 
-Runs a smoke verification (a real witness through the shared engine, a
-couple of Engine API requests, one HTTP round trip incl. GET /metrics),
-then parses the Prometheus exposition and asserts:
+Historically this script ran a runtime smoke (witness + Engine API round
+trip) and parsed the Prometheus exposition; those name/help checks now
+live in the static analyzer (phant_tpu/analysis/rules/metricname.py), so
+there is ONE checker and the two gates cannot drift. The rule covers the
+same invariants statically:
 
-  1. every family name matches `phant_[a-z0-9_]+` (no dots/dashes/upper
-     case leaking into dashboards),
-  2. every family carries a # HELP string — i.e. has an entry in
-     trace.METRIC_HELP, so a new metric name cannot drift in without
-     documentation,
-  3. every METRIC_HELP key still sanitizes to a valid family prefix
-     (catalog rot is also drift).
+  * every emitted metric name is a string literal that sanitizes to a
+    `phant_[a-z0-9_]+` family (trace.prometheus_name is lossless on it),
+  * every emitted name has a `trace.METRIC_HELP` entry,
+  * every METRIC_HELP entry is actually emitted somewhere (catalog rot).
 
-Wired as `make metrics-lint`; exits non-zero with a named offender list.
+Wired as `make metrics-lint`; `make lint` / scripts/check.sh run the full
+rule set (this subset included). Exits non-zero with file:line offenders.
 """
 
 from __future__ import annotations
 
-import json
 import os
-import re
 import sys
-import urllib.request
+from pathlib import Path
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
-# runnable as `python scripts/metrics_lint.py` from the repo root
-sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+# runnable as `python scripts/metrics_lint.py` from anywhere
+_REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(_REPO))
+os.chdir(_REPO)
 
-FAMILY_RE = re.compile(r"^phant_[a-z0-9_]+$")
-# exposition sample line: name{labels} value  |  name value
-SAMPLE_RE = re.compile(r"^([a-zA-Z_:][a-zA-Z0-9_:]*)(\{.*\})? (.+)$")
-# suffixes the renderer appends to a family for its sample series
-SERIES_SUFFIXES = ("_bucket", "_sum", "_count")
+from phant_tpu.analysis import Analyzer, default_rules  # noqa: E402
 
 
-def smoke() -> None:
-    """Touch every instrumented layer once so the exposition is populated."""
-    from phant_tpu import rlp
-    from phant_tpu.crypto.keccak import keccak256
-    from phant_tpu.mpt.mpt import Trie
-    from phant_tpu.mpt.proof import generate_proof
-    from phant_tpu.stateless import verify_witness_nodes
-    from phant_tpu.engine_api import handle_request
-    from phant_tpu.engine_api.server import EngineAPIServer
-    from phant_tpu.utils.trace import metrics
-
-    metrics.reset()
-    # witness engine + stateless verify path
-    t = Trie()
-    for i in range(64):
-        t.put(keccak256(bytes([i])), rlp.encode(rlp.encode_uint(i + 1)))
-    nodes = list(dict.fromkeys(generate_proof(t, keccak256(bytes([0])))))
-    assert verify_witness_nodes(t.root_hash(), nodes)
-    assert verify_witness_nodes(t.root_hash(), nodes)  # cache-hit pass
-    # engine API dispatch counters (no blockchain needed for these)
-    handle_request(None, {"id": 1, "method": "engine_getClientVersionV1", "params": []})
-    handle_request(None, {"id": 2, "method": "totally_bogus"})
-    # HTTP surface: request histogram/gauge + GET /metrics + /healthz
-    server = EngineAPIServer(None, host="127.0.0.1", port=0)
-    server.serve_in_background()
-    try:
-        base = f"http://127.0.0.1:{server.port}"
-        req = urllib.request.Request(
-            base + "/",
-            data=json.dumps({"id": 3, "method": "engine_getClientVersionV1", "params": []}).encode(),
-            headers={"Content-Type": "application/json"},
-        )
-        urllib.request.urlopen(req, timeout=10).read()
-        health = json.loads(
-            urllib.request.urlopen(base + "/healthz", timeout=10).read()
-        )
-        assert health["status"] == "ok", health
-    finally:
-        server.shutdown()
-
-
-def lint() -> int:
-    from phant_tpu.utils.trace import METRIC_HELP, metrics, prometheus_name
-
-    text = metrics.prometheus_text()
-    helped: set = set()
-    families: set = set()
-    errors: list = []
-    for line in text.splitlines():
-        if not line:
-            continue
-        if line.startswith("# HELP "):
-            helped.add(line.split()[2])
-            continue
-        if line.startswith("# TYPE "):
-            families.add(line.split()[2])
-            continue
-        m = SAMPLE_RE.match(line)
-        if m is None:
-            errors.append(f"unparseable exposition line: {line!r}")
-            continue
-        name = m.group(1)
-        base = name
-        for suf in SERIES_SUFFIXES:
-            if base.endswith(suf):
-                base = base[: -len(suf)]
-                break
-        if not FAMILY_RE.match(base):
-            errors.append(f"metric name not phant_[a-z0-9_]+: {name!r}")
-    for fam in sorted(families):
-        if fam not in helped:
-            errors.append(
-                f"family {fam!r} has no help string — add its internal name "
-                "to phant_tpu.utils.trace.METRIC_HELP"
-            )
-    for internal in sorted(METRIC_HELP):
-        fam = prometheus_name(internal)
-        if not FAMILY_RE.match(fam):
-            errors.append(f"METRIC_HELP key {internal!r} sanitizes to invalid {fam!r}")
-    if errors:
-        for e in errors:
-            print(f"[metrics-lint] FAIL: {e}", file=sys.stderr)
+def main() -> int:
+    # same baseline as `make lint` / check.sh: a grandfathered METRICNAME
+    # finding must not make the two gates disagree
+    analyzer = Analyzer(
+        [Path("phant_tpu")],
+        default_rules(["METRICNAME"]),
+        baseline=Path("scripts/phantlint_baseline.json"),
+    )
+    result = analyzer.run()
+    if result.new:
+        for f in result.new:
+            print(f"[metrics-lint] FAIL: {f.render()}", file=sys.stderr)
         return 1
     print(
-        f"[metrics-lint] ok: {len(families)} families, all named "
-        f"phant_[a-z0-9_]+ with help strings"
+        f"[metrics-lint] ok: {result.modules} modules, every metric name "
+        "literal, phant_[a-z0-9_]+-sanitizable, and in METRIC_HELP "
+        f"({result.suppressed} annotated exception(s))"
     )
     return 0
 
 
 if __name__ == "__main__":
-    smoke()
-    sys.exit(lint())
+    sys.exit(main())

@@ -1,0 +1,119 @@
+#!/usr/bin/env python
+"""phantlint CLI — the static-analysis half of the commit gate.
+
+Usage:
+  python scripts/phantlint.py phant_tpu/                 # lint the package
+  python scripts/phantlint.py phant_tpu/ --format=json   # machine-readable
+  python scripts/phantlint.py phant_tpu/ --baseline scripts/phantlint_baseline.json
+  python scripts/phantlint.py phant_tpu/ --write-baseline scripts/phantlint_baseline.json
+  python scripts/phantlint.py --list-rules
+
+Exit status: 0 when every finding is suppressed or baselined, 1 when NEW
+findings exist (the gate), 2 on usage errors. Pure `ast` — no jax import,
+so the full package lints in ~2s regardless of JAX_PLATFORMS.
+
+Wired as `make lint` and as the first group of scripts/check.sh; the
+metric-name half also backs `make metrics-lint` (scripts/metrics_lint.py
+is a thin shim over the METRICNAME rule so the two gates cannot drift).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from pathlib import Path
+
+# runnable as `python scripts/phantlint.py` from the repo root
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from phant_tpu.analysis import (  # noqa: E402
+    Analyzer,
+    default_rules,
+    save_baseline,
+)
+from phant_tpu.analysis.rules import ALL_RULES  # noqa: E402
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="phantlint", description=__doc__)
+    ap.add_argument("paths", nargs="*", default=["phant_tpu"], help="files/dirs")
+    ap.add_argument("--format", choices=("text", "json"), default="text")
+    ap.add_argument(
+        "--baseline",
+        type=Path,
+        default=None,
+        help="baseline JSON of grandfathered findings (missing file = empty)",
+    )
+    ap.add_argument(
+        "--write-baseline",
+        type=Path,
+        default=None,
+        metavar="PATH",
+        help="write current (unsuppressed) findings as the new baseline",
+    )
+    ap.add_argument(
+        "--rules",
+        default=None,
+        help="comma-separated rule subset (default: all)",
+    )
+    ap.add_argument("--list-rules", action="store_true")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        for cls in ALL_RULES:
+            inst = cls()
+            print(f"{inst.name:12s} {inst.description}")
+        return 0
+
+    try:
+        rules = default_rules(
+            args.rules.split(",") if args.rules else None
+        )
+    except ValueError as e:
+        print(f"phantlint: {e}", file=sys.stderr)
+        return 2
+
+    paths = [Path(p) for p in (args.paths or ["phant_tpu"])]
+    for p in paths:
+        if not p.exists():
+            print(f"phantlint: no such path: {p}", file=sys.stderr)
+            return 2
+
+    analyzer = Analyzer(paths, rules, baseline=args.baseline)
+    result = analyzer.run()
+
+    if args.write_baseline is not None:
+        save_baseline(args.write_baseline, result.findings)
+        print(
+            f"phantlint: wrote {len(result.findings)} finding(s) to "
+            f"{args.write_baseline}"
+        )
+        return 0
+
+    if args.format == "json":
+        print(
+            json.dumps(
+                {
+                    "modules": result.modules,
+                    "suppressed": result.suppressed,
+                    "baselined": result.baselined,
+                    "new": [f.to_dict() for f in result.new],
+                },
+                indent=2,
+            )
+        )
+    else:
+        for f in result.new:
+            print(f.render())
+        tail = (
+            f"{result.modules} modules, {len(result.new)} new finding(s), "
+            f"{result.baselined} baselined, {result.suppressed} suppressed"
+        )
+        print(f"phantlint: {tail}", file=sys.stderr)
+    return 1 if result.new else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
