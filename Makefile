@@ -1,5 +1,5 @@
 # Commit gate (VERDICT r2 #4): `make check` must be green before a snapshot.
-.PHONY: check check-fast check-device native sanitize metrics-lint lint
+.PHONY: check check-fast check-device native sanitize metrics-lint lint soak
 
 check:
 	./scripts/check.sh
@@ -42,6 +42,14 @@ sanitize:
 	  native/keccak.cc native/packer.cc native/secp256k1.cc native/engine.cc \
 	  native/selftest.cc
 	./build/native_selftest
+
+# Scheduler soak smoke (scripts/check.sh runs it after the pytest groups):
+# a live Engine API server on the CPU backend takes a few hundred
+# concurrent requests — serial-lane newPayloads, batching-lane stateless
+# verifications, health/metrics scrapes — and must serialize mutation
+# exactly once, coalesce witness batches, shed nothing, and drain clean.
+soak:
+	JAX_PLATFORMS=cpu python scripts/soak.py
 
 # Metric-name drift gate: thin shim over phantlint's METRICNAME rule
 # (one checker — see `make lint`): every emitted name must be a literal,

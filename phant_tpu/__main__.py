@@ -86,6 +86,29 @@ def build_parser() -> argparse.ArgumentParser:
         help="Capture a JAX/XLA device trace of the serving process into "
         "this directory (view with TensorBoard or Perfetto)",
     )
+    # continuous-batching scheduler (phant_tpu/serving/): the knobs of the
+    # admission-queue -> batch-assembler -> executor pipeline
+    p.add_argument(
+        "--sched-max-batch",
+        type=int,
+        default=128,
+        help="Max verification requests coalesced into one engine/device "
+        "batch (scheduler batch assembler)",
+    )
+    p.add_argument(
+        "--sched-max-wait-ms",
+        type=float,
+        default=5.0,
+        help="Max time an under-full batch waits for more requests; bounds "
+        "the latency a lone request pays for batching",
+    )
+    p.add_argument(
+        "--sched-queue-depth",
+        type=int,
+        default=512,
+        help="Admission-queue bound; a full queue rejects with JSON-RPC "
+        "-32050 (overload shedding) instead of building latency",
+    )
     return p
 
 
@@ -130,7 +153,19 @@ def main(argv=None) -> int:
         config=config,
     )
 
-    server = EngineAPIServer(chain, host=args.host, port=args.engine_api_port)
+    from phant_tpu.serving import SchedulerConfig
+
+    sched_config = SchedulerConfig(
+        max_batch=args.sched_max_batch,
+        max_wait_ms=args.sched_max_wait_ms,
+        queue_depth=args.sched_queue_depth,
+    )
+    server = EngineAPIServer(
+        chain,
+        host=args.host,
+        port=args.engine_api_port,
+        sched_config=sched_config,
+    )
     log.info("Engine API listening on %s:%d", args.host, server.port)
     metrics_server = None
     if args.metrics:

@@ -11,16 +11,24 @@ structure for the rules:
     ("pkg.mod.func", "pkg.mod.Class.method") covering: direct calls of
     local/imported functions, `self.method()` (with base-class walk),
     `super().method()`, constructor calls, `alias.func()` module-attribute
-    calls, and `var.method()` where `var` was assigned from a known
-    constructor in the same function;
+    calls, `var.method()` where `var` was assigned from a known
+    constructor in the same function, and stored-attribute calls
+    `self.attr.method()` / `var.attr.method()` where `self.attr = C(...)`
+    appears in any method of the owning class (single-level attribute
+    type tracking — how `Blockchain.run_block`'s `self.signer.…` calls
+    resolve into the signer layer, so HOSTSYNC's reachability covers
+    signer-side syncs without annotated-helper workarounds);
   * jit detection: `@jax.jit`, `@functools.partial(jax.jit, ...)`
     decorators and `name = jax.jit(f)` / `partial(jax.jit, ...)(f)`
     module-level assignments, with their `static_argnames`.
 
-Deliberately NOT a type checker: calls through attributes of unknown
-objects (`self.signer.recover(...)`) resolve to nothing and reachability
-under-approximates there. Rules are written so under-approximation can
-only suppress findings, never invent them.
+Deliberately NOT a type checker: calls through attributes assigned from
+anything but a resolvable constructor (`self.x = factory()`, reassigned
+attrs, deeper chains like `self.a.b.method()`) resolve to nothing and
+reachability under-approximates there. Rules are written so
+under-approximation can only suppress findings, never invent them; an
+attribute assigned different classes in different methods resolves to
+ALL of them (conservative union).
 """
 
 from __future__ import annotations
@@ -48,6 +56,11 @@ class ClassInfo:
     node: ast.ClassDef
     methods: Dict[str, FunctionInfo] = field(default_factory=dict)
     base_names: Tuple[str, ...] = ()  # unresolved (module-local) base names
+    # stored-attribute types: attr name -> dotted constructor names seen in
+    # `self.<attr> = Ctor(...)` across ALL methods (raw at parse time);
+    # Project.__init__ resolves them into `attr_classes` qualname sets
+    attr_ctor_names: Dict[str, Set[str]] = field(default_factory=dict)
+    attr_classes: Dict[str, Set[str]] = field(default_factory=dict)
 
 
 @dataclass
@@ -194,6 +207,7 @@ def parse_module(name: str, path: Path) -> Optional[ModuleInfo]:
                         jitted=jit,
                         static_argnames=statics,
                     )
+                    _collect_attr_ctors(ci, item)
             mi.classes[node.name] = ci
         elif isinstance(node, ast.Assign) and len(node.targets) == 1:
             tgt = node.targets[0]
@@ -203,6 +217,27 @@ def parse_module(name: str, path: Path) -> Optional[ModuleInfo]:
                 else:
                     _maybe_assigned_jit(mi, tgt.id, node.value)
     return mi
+
+
+def _collect_attr_ctors(ci: ClassInfo, method: ast.AST) -> None:
+    """Record `self.<attr> = <Ctor>(...)` assignments (any method, any
+    nesting depth) as raw dotted constructor names; Project.__init__
+    resolves them against the project's classes. Assignments from
+    non-calls or non-self targets are ignored — only a direct constructor
+    call pins a type we can trust."""
+    for node in ast.walk(method):
+        if not (isinstance(node, ast.Assign) and isinstance(node.value, ast.Call)):
+            continue
+        d = _dotted(node.value.func)
+        if d is None:
+            continue
+        for tgt in node.targets:
+            if (
+                isinstance(tgt, ast.Attribute)
+                and isinstance(tgt.value, ast.Name)
+                and tgt.value.id == "self"
+            ):
+                ci.attr_ctor_names.setdefault(tgt.attr, set()).add(d)
 
 
 def _maybe_assigned_jit(mi: ModuleInfo, name: str, value: ast.AST) -> None:
@@ -249,6 +284,20 @@ class Project:
                 self.classes[ci.qualname] = ci
                 for fi in ci.methods.values():
                     self.functions[fi.qualname] = fi
+        # resolve stored-attribute constructor names BEFORE the call graph
+        # is built (the graph consumes attr_classes)
+        for mi in modules.values():
+            for ci in mi.classes.values():
+                for attr, ctors in ci.attr_ctor_names.items():
+                    resolved = {
+                        q
+                        for q in (
+                            self.resolve_name(mi.name, d) for d in ctors
+                        )
+                        if q is not None and q in self.classes
+                    }
+                    if resolved:
+                        ci.attr_classes[attr] = resolved
         self.call_graph: Dict[str, Set[str]] = {}
         for mi in modules.values():
             for fi in mi.functions.values():
@@ -306,6 +355,30 @@ class Project:
                     stack.append(base)
         return None
 
+    def attr_classes_of(self, ci: ClassInfo, attr: str) -> List[ClassInfo]:
+        """The resolved class(es) a stored attribute may hold, walking base
+        classes like method_of. Conservative union: an attribute assigned
+        different constructors in different methods returns all of them."""
+        seen: Set[str] = set()
+        out: List[ClassInfo] = []
+        stack = [ci]
+        while stack:
+            c = stack.pop()
+            if c.qualname in seen:
+                continue
+            seen.add(c.qualname)
+            for q in c.attr_classes.get(attr, ()):
+                target = self.classes.get(q)
+                if target is not None and target.qualname not in {
+                    o.qualname for o in out
+                }:
+                    out.append(target)
+            for b in c.base_names:
+                base = self.resolve_class(c.module, b)
+                if base is not None:
+                    stack.append(base)
+        return out
+
     # -- call graph ---------------------------------------------------------
 
     def _calls_of(self, mi: ModuleInfo, fi: FunctionInfo) -> Set[str]:
@@ -354,6 +427,28 @@ class Project:
                     m = self.method_of(var_classes[recv], func.attr)
                     if m is not None:
                         out.add(m.qualname)
+                        continue
+            # self.attr.m(...) / var.attr.m(...): stored-attribute types
+            # (`self.signer = TxSigner(...)` -> `self.signer.get_sender()`)
+            if (
+                isinstance(func, ast.Attribute)
+                and isinstance(func.value, ast.Attribute)
+                and isinstance(func.value.value, ast.Name)
+            ):
+                recv = func.value.value.id
+                holder: Optional[ClassInfo] = None
+                if recv == "self" and owner is not None:
+                    holder = owner
+                elif recv in var_classes:
+                    holder = var_classes[recv]
+                if holder is not None:
+                    resolved_any = False
+                    for target in self.attr_classes_of(holder, func.value.attr):
+                        m = self.method_of(target, func.attr)
+                        if m is not None:
+                            out.add(m.qualname)
+                            resolved_any = True
+                    if resolved_any:
                         continue
             d = _dotted(func)
             if d is None:

@@ -602,6 +602,7 @@ SUPPORTED_METHODS = (
 def handle_request(blockchain, request: dict) -> Tuple[int, dict]:
     """Dispatch one JSON-RPC request; returns (http_status, response_body)
     (reference: engineAPIHandler, main.zig:56-74)."""
+    from phant_tpu.serving import SchedulerError
     from phant_tpu.utils.trace import metrics
 
     req_id = request.get("id")
@@ -671,6 +672,11 @@ def handle_request(blockchain, request: dict) -> Tuple[int, dict]:
                 **base,
                 "result": shared_witness_engine().stats_snapshot(),
             }
+    except SchedulerError:
+        # scheduler overload/deadline/down is a transport-level condition,
+        # not bad params — the HTTP layer maps it to its distinct JSON-RPC
+        # code and a 503 (engine_api/server.py)
+        raise
     except Exception as e:  # malformed params etc.
         return 200, {**base, "error": {"code": -32602, "message": str(e)}}
     # unimplemented-but-known vs unknown (reference: res.status=500 main.zig:72)

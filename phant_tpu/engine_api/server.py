@@ -2,13 +2,29 @@
 
 Equivalent surface to the reference's httpz server wiring (reference:
 src/main.zig:143-149: POST / routed to engineAPIHandler with the
-*Blockchain as per-request context). Uses the stdlib ThreadingHTTPServer —
-the handler holds a lock around block execution because `Blockchain`
-mutates shared state (the reference is effectively serial there too).
+*Blockchain as per-request context). Uses the stdlib ThreadingHTTPServer.
+
+Request execution goes through the continuous-batching scheduler
+(phant_tpu/serving/) instead of the old global execution lock:
+
+* state-mutating methods (`engine_newPayload*`, `engine_forkchoiceUpdated*`)
+  run as SERIAL jobs on the scheduler's single executor thread — mutation
+  stays exclusive (the reference is effectively serial there too) without
+  a mutex held across the whole request;
+* `engine_executeStatelessPayloadV1` runs CONCURRENTLY on the handler
+  threads (stateless execution shares nothing), and its witness
+  verification coalesces with other in-flight requests into one
+  engine/device `verify_batch` dispatch via the scheduler's batch
+  assembler (stateless.verify_witness_nodes);
+* scheduler rejections map to distinct JSON-RPC errors: queue full
+  -32050, deadline expired -32051, executor down -32052 — all HTTP 503,
+  counted under `sched.rejected{reason=...}`.
 
 Observability surface: `GET /metrics` serves the process metrics registry
-as Prometheus text exposition, `GET /healthz` a JSON liveness probe;
-every POST is counted, latency-histogrammed, and gauge-tracked in flight
+as Prometheus text exposition, `GET /healthz` a JSON liveness probe that
+includes the scheduler state (queue depth, executor liveness) and turns
+503 when the executor has died; every POST is counted,
+latency-histogrammed, and gauge-tracked in flight
 (phant_tpu/utils/trace.py). `serve_metrics()` runs the same two GET
 endpoints standalone for `--metrics-port` deployments where the Engine API
 port is CL-only."""
@@ -22,22 +38,47 @@ import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from phant_tpu.engine_api import handle_request
+from phant_tpu.serving import (
+    SchedulerConfig,
+    SchedulerError,
+    VerificationScheduler,
+    active_scheduler,
+    install,
+    uninstall,
+)
 from phant_tpu.utils.trace import metrics
 
 log = logging.getLogger("phant_tpu.engine_api")
 
 _START_MONOTONIC = time.monotonic()
 
+#: methods that mutate Blockchain state and therefore run as serial jobs
+#: on the scheduler's executor (everything else is read-only or stateless
+#: and runs concurrently on the handler threads)
+_SERIAL_METHOD_PREFIXES = ("engine_newPayload", "engine_forkchoiceUpdated")
 
-def _healthz_payload() -> dict:
+
+def _healthz_payload() -> tuple:
+    """(http_status, payload): liveness plus scheduler state. A dead
+    scheduler executor means the node can no longer execute payloads, so
+    the probe reports 503 — orchestrators must restart, not route."""
     from phant_tpu.version import RELEASE, revision
 
-    return {
+    payload = {
         "status": "ok",
         "version": RELEASE,
         "revision": revision(),
         "uptime_s": round(time.monotonic() - _START_MONOTONIC, 1),
     }
+    status = 200
+    sched = active_scheduler()
+    if sched is not None:
+        st = sched.state()
+        payload["scheduler"] = st
+        if not st["executor_alive"]:
+            payload["status"] = "unhealthy"
+            status = 503
+    return status, payload
 
 
 class _ObservableHandler(BaseHTTPRequestHandler):
@@ -54,7 +95,8 @@ class _ObservableHandler(BaseHTTPRequestHandler):
                 "text/plain; version=0.0.4; charset=utf-8",
             )
         elif path == "/healthz":
-            self._reply(200, _healthz_payload())
+            status, payload = _healthz_payload()
+            self._reply(status, payload)
         else:
             self._reply(404, {"error": "not found"})
 
@@ -83,11 +125,31 @@ class _ObservableHandler(BaseHTTPRequestHandler):
 
 
 class EngineAPIServer:
-    """HTTP server bound to a Blockchain (reference: main.zig:143-149)."""
+    """HTTP server bound to a Blockchain (reference: main.zig:143-149).
 
-    def __init__(self, blockchain, host: str = "127.0.0.1", port: int = 8551):
+    Owns a `VerificationScheduler` (phant_tpu/serving/): construction
+    installs it as the process's active scheduler (so
+    stateless.verify_witness_nodes and `/healthz` see it) and shutdown
+    drains + uninstalls it. Pass `scheduler=` to share one across
+    servers — then the CALLER owns its lifecycle (shutdown here only
+    undoes this server's install, never drains a shared scheduler out
+    from under its other users) — or `sched_config=` to size the
+    queue/batch policy (the `--sched-*` CLI flags,
+    phant_tpu/__main__.py)."""
+
+    def __init__(
+        self,
+        blockchain,
+        host: str = "127.0.0.1",
+        port: int = 8551,
+        scheduler: VerificationScheduler = None,
+        sched_config: SchedulerConfig = None,
+    ):
         self.blockchain = blockchain
-        self._lock = threading.Lock()
+        self._owns_scheduler = scheduler is None
+        if scheduler is None:
+            scheduler = VerificationScheduler(config=sched_config)
+        self.scheduler = scheduler
         outer = self
 
         class Handler(_ObservableHandler):
@@ -95,14 +157,14 @@ class EngineAPIServer:
                 t0 = time.perf_counter()
                 # Lock-discipline audit (phantlint LOCK, PR 2): the
                 # counter / in-flight gauge / latency-histogram updates
-                # here run OUTSIDE outer._lock on purpose — the registry
-                # has its own internal lock (trace.Metrics._lock), and
-                # holding the request lock across observability writes
-                # would serialize the very concurrency the in-flight gauge
-                # measures. phantlint's LOCK rule scopes to the lock-owning
-                # object's own attributes, so it (correctly) reports
-                # nothing here — this comment, not a disable annotation,
-                # is the audit record.
+                # here deliberately run on the handler thread with no
+                # exclusion — the registry has its own internal lock
+                # (trace.Metrics._lock), and serializing observability
+                # writes would serialize the very concurrency the
+                # in-flight gauge measures. phantlint's LOCK rule scopes
+                # to the lock-owning object's own attributes, so it
+                # (correctly) reports nothing here — this comment, not a
+                # disable annotation, is the audit record.
                 metrics.gauge_add("engine_api.inflight", 1)
                 try:
                     self._handle_post()
@@ -133,13 +195,53 @@ class EngineAPIServer:
                         },
                     )
                     return
-                with outer._lock:
-                    status, response = handle_request(outer.blockchain, request)
+                method = request.get("method", "")
+                try:
+                    if isinstance(method, str) and method.startswith(
+                        _SERIAL_METHOD_PREFIXES
+                    ):
+                        # state-mutating: exclusive execution on the
+                        # scheduler's single executor thread (the global
+                        # lock's replacement — admission-ordered, drained
+                        # on shutdown, fails fast on executor death)
+                        status, response = outer.scheduler.submit_serial(
+                            lambda: handle_request(outer.blockchain, request)
+                        ).result()
+                    else:
+                        # read-only / stateless: run concurrently on THIS
+                        # handler thread; witness verification inside
+                        # coalesces via the scheduler's batch assembler
+                        status, response = handle_request(
+                            outer.blockchain, request
+                        )
+                except SchedulerError as e:
+                    # overload / deadline / executor-down: distinct
+                    # JSON-RPC codes (-32050/-32051/-32052) over HTTP 503
+                    metrics.count("engine_api.request_errors")
+                    self._reply(
+                        e.http_status,
+                        {
+                            "jsonrpc": "2.0",
+                            "id": request.get("id"),
+                            "error": {"code": e.code, "message": str(e)},
+                        },
+                    )
+                    return
                 if status >= 400 or "error" in response:
                     metrics.count("engine_api.request_errors")
                 self._reply(status, response)
 
-        self._server = ThreadingHTTPServer((host, port), Handler)
+        try:
+            self._server = ThreadingHTTPServer((host, port), Handler)
+        except BaseException:
+            # a bind failure must not leak the executor thread this
+            # constructor just spawned (nobody else holds a reference)
+            if self._owns_scheduler:
+                scheduler.shutdown(drain=False)
+            raise
+        # install only after the socket bound: a bind failure must not
+        # leak a process-globally installed scheduler
+        install(scheduler)
 
     @property
     def port(self) -> int:
@@ -155,8 +257,18 @@ class EngineAPIServer:
         return t
 
     def shutdown(self) -> None:
+        """Graceful: stop accepting connections, then drain the scheduler
+        (queued serial/witness jobs complete so in-flight handlers get
+        real answers), then release the socket and the scheduler slot.
+        A caller-provided (shared) scheduler is NOT drained — only this
+        server's install is undone; its lifecycle belongs to the caller."""
         self._server.shutdown()
-        self._server.server_close()
+        try:
+            if self._owns_scheduler:
+                self.scheduler.shutdown(drain=True)
+        finally:
+            uninstall(self.scheduler)
+            self._server.server_close()
 
 
 class MetricsServer:

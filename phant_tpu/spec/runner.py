@@ -283,10 +283,28 @@ def main() -> int:
         help="re-execute every block from a witness of its pre-state "
         "(the engine_executeStatelessPayloadV1 machinery)",
     )
+    parser.add_argument(
+        "--sched",
+        action="store_true",
+        help="route witness verification through the continuous-batching "
+        "scheduler (phant_tpu/serving/) — the IDENTICAL batching code the "
+        "Engine API serves with, for serving-path parity runs",
+    )
     args = parser.parse_args()
     if not args.root.is_dir():
         parser.error(f"fixture directory not found: {args.root}")
-    stats = run_directory(args.root, stateless=args.stateless)
+    sched = None
+    if args.sched:
+        from phant_tpu.serving import VerificationScheduler, install, uninstall
+
+        sched = VerificationScheduler()
+        install(sched)
+    try:
+        stats = run_directory(args.root, stateless=args.stateless)
+    finally:
+        if sched is not None:
+            uninstall(sched)
+            sched.shutdown()
     if stats.passed + stats.failed == 0:
         parser.error(f"no fixture JSONs under {args.root}")
     for line in stats.failures:

@@ -495,13 +495,27 @@ def verify_witness_nodes(state_root: bytes, nodes: List[bytes]) -> bool:
     rooted at `state_root` — through the shared memoized engine. Semantics
     are identical to the host BFS (mpt/proof.py verify_witness_linked) and
     the device kernel (ops/witness_jax.witness_verify_fused); all three are
-    differential-tested against each other."""
+    differential-tested against each other.
+
+    Serving mode: when a continuous-batching scheduler is installed
+    (phant_tpu/serving/ — the Engine API server installs one), the check
+    routes through it so concurrent handler threads coalesce into ONE
+    `verify_batch` engine/device dispatch instead of paying a batch-of-1
+    each. Scheduler rejections (queue full, deadline, executor down)
+    propagate as SchedulerError for the server to map to JSON-RPC errors.
+    Without a scheduler — offline tools, tests, the spec runner by
+    default — the direct shared-engine path is unchanged."""
     if state_root == EMPTY_TRIE_ROOT:
         # the empty pre-state needs (and admits) no witness nodes — same
         # contract as the host BFS (mpt/proof.py verify_witness_linked)
         return not nodes
     if not nodes:
         return False
+    from phant_tpu.serving import active_scheduler
+
+    sched = active_scheduler()
+    if sched is not None and sched.accepts_witness():
+        return bool(sched.submit_witness(state_root, nodes).result())
     return shared_witness_engine().verify(state_root, nodes)
 
 

@@ -149,6 +149,15 @@ METRIC_HELP: Dict[str, str] = {
     "witness_engine.intern": "Interning/scan phase of verify_batch (cache probe + table insert)",
     "witness_engine.hash": "Novel-node keccak phase of verify_batch (includes the C-side commit+join on the finish_native fast path)",
     "witness_engine.linkage_join": "Parent->child linkage join / verdict phase of verify_batch",
+    # continuous-batching scheduler (phant_tpu/serving/)
+    "sched.queue_depth": "Verification requests currently in the scheduler admission queue",
+    "sched.batch_size": "Assembled witness-batch sizes (requests per engine dispatch)",
+    "sched.queue_wait_seconds": "Admission-to-execution wait per scheduled request",
+    "sched.coalesced_requests": "Requests that shared an engine batch with at least one other request",
+    "sched.rejected": "Scheduler rejections by reason (queue_full/deadline/down/shutdown)",
+    "sched.batches": "Scheduler executions by lane (witness batches / serial jobs)",
+    "sched.padding_waste": "Unused fraction of the padded device buffer the last witness batch would occupy",
+    "sched.executor_crashes": "Scheduler executor crashes (scheduler marked down, /healthz -> 503)",
     # crypto backend dispatch
     "keccak.batches": "Batched keccak dispatches by backend",
     "keccak.bytes": "Payload bytes submitted to batched keccak by backend",

@@ -79,6 +79,16 @@ else
   echo "[check] PHANT_CHECK_DEVICE=0: device-kernel groups SKIPPED (not a full gate)"
 fi
 
+# Scheduler soak smoke AFTER the pytest groups: a live server under
+# multi-threaded mixed traffic (serial-lane newPayloads + batching-lane
+# stateless verifications) must serialize mutation exactly once, coalesce
+# witness batches, shed nothing, and drain clean (phant_tpu/serving/).
+t0=$(date +%s)
+JAX_PLATFORMS=cpu python scripts/soak.py > build/logs/soak.log 2>&1
+rc=$?
+echo "[check] group soak: rc=$rc in $(( $(date +%s) - t0 ))s"
+if [ "$rc" -ne 0 ]; then cat build/logs/soak.log; fail=1; fi
+
 total=$(( $(date +%s) - start ))
 if [ "$fail" -ne 0 ]; then
   echo "[check] RED in ${total}s (cache: $PHANT_JAX_CACHE)"

@@ -1,0 +1,158 @@
+#!/usr/bin/env python
+"""Scheduler soak smoke: hammer a live Engine API server from threads.
+
+`make soak` / scripts/check.sh run this after the pytest groups as the
+serving subsystem's end-to-end gate: a real `EngineAPIServer` (CPU
+backend, ephemeral port) takes a few hundred concurrent requests from a
+small thread pool — state-mutating newPayloads (the scheduler's serial
+lane), stateless verifications (the batching lane), read-only RPCs, and
+`/healthz`/`/metrics` scrapes — then shuts down gracefully.
+
+Pass criteria (exit 1 otherwise):
+  * every request completes at the HTTP layer (no transport errors);
+  * exactly ONE newPayload lands VALID (serialization held: the N-1
+    replays are INVALID, never double-applied) and the chain advanced
+    exactly once;
+  * every stateless verification returns VALID with the expected root,
+    and at least one engine batch coalesced >1 requests;
+  * the scheduler sheds nothing (queue sized for the load: rejected == 0)
+    and its executor is still alive at the end;
+  * shutdown drains cleanly (no queued work abandoned, the scheduler
+    slot is released).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import urllib.request
+from concurrent.futures import ThreadPoolExecutor
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def _get(base: str, path: str):
+    with urllib.request.urlopen(base + path, timeout=60) as resp:
+        return resp.status, resp.read()
+
+
+def main() -> int:
+    threads = int(os.environ.get("PHANT_SOAK_THREADS", "8"))
+    rounds = int(os.environ.get("PHANT_SOAK_ROUNDS", "12"))
+
+    # deferred imports: JAX_PLATFORMS must be pinned first
+    from phant_tpu.config import ChainId
+    from phant_tpu.blockchain.chain import Blockchain
+    from phant_tpu.engine_api.server import EngineAPIServer
+    from phant_tpu.serving import SchedulerConfig, active_scheduler
+    from phant_tpu.state.statedb import StateDB
+    from phant_tpu.__main__ import make_genesis_parent_header
+
+    sys.path.insert(
+        0,
+        os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "tests"),
+    )
+    # _post too: one JSON-RPC client shape shared with the test suite
+    from test_serving import _post, _stateless_request, _valid_payload_json
+
+    chain = Blockchain(
+        chain_id=int(ChainId.Testing),
+        state=StateDB(),
+        parent_header=make_genesis_parent_header(),
+        verify_state_root=False,
+    )
+    stateless_chain, stateless_rpc, want_root = _stateless_request()
+    new_payload_rpc = {
+        "jsonrpc": "2.0",
+        "id": 1,
+        "method": "engine_newPayloadV2",
+        "params": [_valid_payload_json()],
+    }
+    version_rpc = {"jsonrpc": "2.0", "id": 2, "method": "engine_getClientVersionV1", "params": []}
+
+    # ONE server, ONE scheduler: the newPayload chain serves the serial
+    # lane; stateless requests carry their own self-contained pre-state so
+    # they ride the same server regardless of its resident chain state —
+    # but executeStateless resolves parent/config through the bound chain,
+    # so bind the stateless-parent chain and let newPayload mutate it.
+    del chain
+    server = EngineAPIServer(
+        stateless_chain,
+        host="127.0.0.1",
+        port=0,
+        sched_config=SchedulerConfig(max_batch=32, max_wait_ms=20.0, queue_depth=1024),
+    )
+    server.serve_in_background()
+    base = f"http://127.0.0.1:{server.port}"
+    failures: list = []
+    valid_newpayloads = 0
+    stateless_ok = 0
+    total = 0
+
+    def one_round(r: int) -> list:
+        out = []
+        out.append(("newPayload", _post(base, new_payload_rpc)))
+        out.append(("stateless", _post(base, stateless_rpc)))
+        out.append(("version", _post(base, version_rpc)))
+        out.append(("healthz", _get(base, "/healthz")))
+        if r % 3 == 0:
+            out.append(("metrics", _get(base, "/metrics")))
+        return out
+
+    try:
+        with ThreadPoolExecutor(max_workers=threads) as pool:
+            for results in pool.map(one_round, range(threads * rounds)):
+                for kind, (code, body) in results:
+                    total += 1
+                    if kind == "newPayload":
+                        if code != 200:
+                            failures.append(f"newPayload HTTP {code}: {body}")
+                        elif body["result"]["status"] == "VALID":
+                            valid_newpayloads += 1
+                        elif body["result"]["status"] != "INVALID":
+                            failures.append(f"newPayload odd status: {body}")
+                    elif kind == "stateless":
+                        if code != 200 or body["result"]["status"] != "VALID":
+                            failures.append(f"stateless failed ({code}): {body}")
+                        elif body["result"]["stateRoot"] != want_root:
+                            failures.append(f"stateless wrong root: {body}")
+                        else:
+                            stateless_ok += 1
+                    elif code != 200:
+                        failures.append(f"{kind} HTTP {code}")
+        st = server.scheduler.stats_snapshot()
+        state = server.scheduler.state()
+    finally:
+        server.shutdown()
+
+    n_rounds = threads * rounds
+    if valid_newpayloads != 1:
+        failures.append(f"{valid_newpayloads} VALID newPayloads (want exactly 1)")
+    if stateless_ok != n_rounds:
+        failures.append(f"{stateless_ok}/{n_rounds} stateless VALID")
+    if st["rejected"] != 0:
+        failures.append(f"scheduler shed {st['rejected']} requests under a sized queue")
+    if st["coalesced"] < 2:
+        failures.append(f"no coalesced batches under {threads}-way load: {st}")
+    if not state["executor_alive"]:
+        failures.append(f"executor dead at end: {state}")
+    if active_scheduler() is not None:
+        failures.append("scheduler slot not released after shutdown")
+
+    print(
+        f"[soak] {total} requests over {threads} threads: "
+        f"1 VALID newPayload + {n_rounds - 1} serialized replays, "
+        f"{stateless_ok} stateless VALID, scheduler stats {st}"
+    )
+    if failures:
+        for f in failures:
+            print(f"[soak] FAIL: {f}", file=sys.stderr)
+        return 1
+    print("[soak] green: no errors, clean drain")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

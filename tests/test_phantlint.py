@@ -101,6 +101,62 @@ def chained():
     assert "bool(c)" in res.new[0].message
 
 
+STORED_ATTR_SRC = '''
+import jax
+import jax.numpy as jnp
+
+@jax.jit
+def kernel(x):
+    return x + 1
+
+class Worker:
+    def work(self):
+        out = kernel(jnp.zeros((4,), jnp.uint32))
+        return out.item()
+
+class Caller:
+    def __init__(self):
+        self.helper = Worker()
+
+    def go(self):
+        return self.helper.work()
+
+def via_var():
+    c = Caller()
+    return c.helper.work()
+'''
+
+
+def test_hostsync_resolves_stored_attribute_calls(tmp_path, monkeypatch):
+    """`self.helper = Worker()` must make `self.helper.work()` resolve into
+    Worker.work — with the METHOD as the entry (not the class), so the
+    constructor-marker blanket cannot paper over a missing attribute edge
+    (the `self.signer.…` gap from ROADMAP open item (b))."""
+    res = run_fixture(
+        tmp_path,
+        monkeypatch,
+        {"attrs.py": STORED_ATTR_SRC},
+        [HostSyncRule(entries=("pkg.attrs.Caller.go",))],
+    )
+    assert len(res.new) == 1, [f.message for f in res.new]
+    assert res.new[0].context == "pkg.attrs.Worker.work"
+    assert ".item()" in res.new[0].message
+
+
+def test_hostsync_resolves_var_attribute_calls(tmp_path, monkeypatch):
+    """`c = Caller(); c.helper.work()` resolves through the constructor-
+    typed local AND the stored attribute in one chain."""
+    res = run_fixture(
+        tmp_path,
+        monkeypatch,
+        {"attrs.py": STORED_ATTR_SRC},
+        [HostSyncRule(entries=("pkg.attrs.via_var",))],
+    )
+    assert any(f.context == "pkg.attrs.Worker.work" for f in res.new), [
+        f.message for f in res.new
+    ]
+
+
 def test_hostsync_disable_comment_suppresses(tmp_path, monkeypatch):
     src = HOT_SRC.replace(
         "    v = out.item()",
