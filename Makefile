@@ -1,5 +1,5 @@
 # Commit gate (VERDICT r2 #4): `make check` must be green before a snapshot.
-.PHONY: check check-fast check-device native sanitize metrics-lint lint soak
+.PHONY: check check-fast check-device native sanitize metrics-lint lint soak trend
 
 check:
 	./scripts/check.sh
@@ -48,8 +48,18 @@ sanitize:
 # concurrent requests — serial-lane newPayloads, batching-lane stateless
 # verifications, health/metrics scrapes — and must serialize mutation
 # exactly once, coalesce witness batches, shed nothing, and drain clean.
+# It then induces ONE executor crash in a throwaway server and asserts the
+# obs flight recorder wrote a well-formed postmortem dump (build/flight/)
+# that names the crashing batch and its request trace ids.
 soak:
 	JAX_PLATFORMS=cpu python scripts/soak.py
+
+# Regression sentinel over the committed BENCH_r*/MULTICHIP_r* artifacts:
+# aligns every section metric across rounds and flags a latest-round value
+# outside the noise-aware bar (or a round that produced no artifact at
+# all). check.sh runs it --report-only; strict mode exits 1 on a flag.
+trend:
+	python scripts/benchtrend.py
 
 # Metric-name drift gate: thin shim over phantlint's METRICNAME rule
 # (one checker — see `make lint`): every emitted name must be a literal,

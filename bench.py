@@ -71,6 +71,22 @@ Platform selection is loud: a broken tunnel degrades to CPU only with
 detail.tpu_expected_but_absent set (PHANT_BENCH_REQUIRE_TPU=1 hard-fails
 instead) — a dead tunnel must never masquerade as a CPU baseline.
 
+WALL-CLOCK BUDGET (round-5 postmortem): BENCH_r05 shipped `parsed: null`
+because the budgets were INVERTED — the internal global deadline defaulted
+to 2400s while the driver killed the run at ~1764s elapsed (the r05 tail:
+late-probe retries stop at "636s of global budget left" = 2400-636), so
+the internal partial-emit deadline could never fire, and the pre-PR3 code
+had no SIGTERM handler to catch the external kill. The driver's `timeout`
+also wraps a SHELL (`if [ -f bench.py ]; then ...`), and `timeout -k`
+escalates to SIGKILL after a short grace — the only robust contract is to
+finish FIRST. The bench therefore (a) defaults its internal budget to
+1500s, comfortably under the observed driver window, (b) checks the
+remaining budget BEFORE each section and skips what no longer fits —
+annotated in detail.skipped_budget — instead of starting work the deadline
+will destroy, and (c) on SIGTERM/SIGINT emits the partial artifact BEFORE
+reaping children. tests/test_bench_contract.py pins the contract by
+running bench under a deliberately short shell-wrapped external timeout.
+
 PHASE ATTRIBUTION (detail.metrics): the process metrics registry
 (phant_tpu/utils/trace.py) is RESET before each section and snapshotted
 after it, so every artifact carries per-section phase attribution instead
@@ -502,6 +518,24 @@ class _watchdog:
 _PARTIAL = {"detail": {}}  # progressively filled; the global deadline prints it
 _CHILDREN: list = []  # live child Popen handles, killed on forced exit
 
+#: self-imposed wall budget (seconds). MUST stay below the driver's external
+#: timeout (observed ~1800s in round 5): the artifact only exists if bench
+#: finishes and prints before the outside world kills it (see module
+#: docstring, WALL-CLOCK BUDGET).
+_GLOBAL_BUDGET = float(os.environ.get("PHANT_BENCH_GLOBAL_TIMEOUT", "1500"))
+
+#: wall-clock held back for the final JSON emit (and the last child reap)
+_BUDGET_RESERVE = float(os.environ.get("PHANT_BENCH_BUDGET_RESERVE", "60"))
+
+
+def _skip_budget(detail: dict, name: str) -> None:
+    """Annotate a section the budget no longer fits: the artifact says
+    SKIPPED loudly instead of silently lacking the keys."""
+    skipped = detail.setdefault("skipped_budget", [])
+    if name not in skipped:
+        skipped.append(name)
+    _log(f"section {name} SKIPPED (wall budget exhausted)")
+
 
 def _pin_jax_cpu() -> None:
     """Force jax onto the host CPU for inline (non-child) device sections:
@@ -576,7 +610,21 @@ def _chip_efficiency(detail: dict) -> dict:
 
 
 def _emit_final() -> None:
-    detail = _PARTIAL.get("detail", {})
+    # the deadline/signal paths call this from a SECOND thread while the
+    # main thread may still be inserting keys — serialize a private copy,
+    # or json.dumps can die mid-iteration and strand the artifact (the
+    # parsed:null failure this function exists to prevent)
+    import copy
+
+    live = _PARTIAL.get("detail", {})
+    for _ in range(3):
+        try:
+            detail = copy.deepcopy(live)
+            break
+        except RuntimeError:  # dict mutated mid-copy: racing main thread
+            continue
+    else:
+        detail = dict(live)  # best effort: top-level snapshot
     eff = _chip_efficiency(detail)
     if eff:
         detail["efficiency"] = eff
@@ -588,29 +636,33 @@ def _emit_final() -> None:
                 "unit": "blocks/s",
                 "vs_baseline": _PARTIAL.get("vs_baseline", 0.0),
                 "detail": detail,
-            }
+            },
+            default=str,
         ),
         flush=True,
     )
 
 
 def _arm_global_deadline() -> None:
-    """Daemon thread: if the whole bench exceeds PHANT_BENCH_GLOBAL_TIMEOUT
-    (default 2400s), print the JSON line from everything measured so far,
-    kill any live children, and exit. The driver must ALWAYS receive one
-    JSON line."""
+    """Daemon thread: if the whole bench exceeds the wall budget
+    (PHANT_BENCH_GLOBAL_TIMEOUT, default 1500s — deliberately BELOW the
+    driver's external timeout), print the JSON line from everything
+    measured so far, kill any live children, and exit. The driver must
+    ALWAYS receive one JSON line; the per-section budget checks normally
+    finish the run long before this backstop fires."""
     import threading
 
-    deadline = float(os.environ.get("PHANT_BENCH_GLOBAL_TIMEOUT", "2400"))
+    deadline = _GLOBAL_BUDGET
 
     def fire():
         _PARTIAL["detail"]["global_deadline_hit_s"] = deadline
+        # emit FIRST: the artifact must exist even if a child reap hangs
+        _emit_final()
         for p in _CHILDREN:
             try:
                 p.kill()
             except Exception:
                 pass
-        _emit_final()
         os._exit(0)
 
     t = threading.Timer(deadline, fire)
@@ -1610,18 +1662,20 @@ def main() -> None:
     # path as the internal global deadline.
     def _on_term(signum, _frame):
         _PARTIAL["detail"]["terminated_by_signal"] = signum
+        # emit FIRST: `timeout -k` escalates TERM->KILL after a short
+        # grace, and the artifact matters more than reaping children
+        _emit_final()
         for p in _CHILDREN:
             try:
                 p.kill()
             except Exception:
                 pass
-        _emit_final()
         os._exit(0)
 
     _signal.signal(_signal.SIGTERM, _on_term)
     _signal.signal(_signal.SIGINT, _on_term)
     t_start = time.perf_counter()
-    global_budget = float(os.environ.get("PHANT_BENCH_GLOBAL_TIMEOUT", "2400"))
+    global_budget = _GLOBAL_BUDGET
     _arm_global_deadline()
     detail = _PARTIAL["detail"]
 
@@ -1668,6 +1722,15 @@ def main() -> None:
 
     def remaining() -> float:
         return global_budget - (time.perf_counter() - t_start)
+
+    def afford(env_key: str, default: int) -> int:
+        """A section watchdog capped at what the wall budget can still
+        afford (reserve intact): one definition so the three in-process
+        section kinds cannot drift."""
+        return min(
+            int(os.environ.get(env_key, default)),
+            max(int(remaining() - _BUDGET_RESERVE), 1),
+        )
 
     alive = False
     n_initial = int(os.environ.get("PHANT_BENCH_PROBE_RETRIES", "2"))
@@ -1722,8 +1785,8 @@ def main() -> None:
                 ),
                 remaining() - 90,  # leave room for the final print
             )
-            if budget < 60:
-                detail[f"{name}_device_error"] = "global budget exhausted"
+            if budget < max(60.0, _BUDGET_RESERVE):
+                _skip_budget(detail, f"{name}_device")
                 continue
             device_env["PHANT_BENCH_DEVICE"] = "1"
             frag = _spawn_section(name, budget, device_env)
@@ -1734,13 +1797,19 @@ def main() -> None:
         for name, fn in _CPU_SECTIONS.items():
             if name not in selected:
                 continue
+            # budget check BEFORE starting: work the deadline would kill
+            # mid-flight is better spent emitting what already finished
+            if remaining() < _BUDGET_RESERVE:
+                _skip_budget(detail, name)
+                continue
             _log(f"cpu section {name} ...")
             t0 = time.perf_counter()
             _metrics_reset()
             try:
-                with _watchdog(
-                    int(os.environ.get("PHANT_BENCH_SECTION_TIMEOUT", "480"))
-                ):
+                # the watchdog is capped at what the wall budget can still
+                # afford, so a slow section times out into ITS error key
+                # (with the reserve intact) instead of eating the run
+                with _watchdog(afford("PHANT_BENCH_SECTION_TIMEOUT", 480)):
                     _merge_frag(detail, fn())
             except Exception as e:
                 detail[f"{name}_cpu_error"] = repr(e)[:200]
@@ -1762,11 +1831,14 @@ def main() -> None:
                 continue
             if name == "keccak" and os.environ.get("PHANT_BENCH_KECCAK", "1") in ("0", ""):
                 continue
+            if remaining() < _BUDGET_RESERVE:
+                _skip_budget(detail, f"{name}_device_inline")
+                continue
             _log(f"inline device section {name} ...")
             t0 = time.perf_counter()
             _metrics_reset()
             try:
-                with _watchdog():
+                with _watchdog(afford("PHANT_BENCH_SECTION_TIMEOUT", 480)):
                     _merge_frag(detail, _DEVICE_SECTIONS[name]())
             except Exception as e:
                 detail[f"{name}_device_error"] = repr(e)[:200]
@@ -1775,11 +1847,12 @@ def main() -> None:
         if "ecrecover" in selected and os.environ.get(
             "PHANT_BENCH_ECRECOVER", "1"
         ) not in ("0", ""):
+            if remaining() < _BUDGET_RESERVE:
+                _skip_budget(detail, "ecrecover_device_inline")
+                return
             _metrics_reset()
             try:
-                with _watchdog(
-                    int(os.environ.get("PHANT_BENCH_ECRECOVER_TIMEOUT", "900"))
-                ):
+                with _watchdog(afford("PHANT_BENCH_ECRECOVER_TIMEOUT", 900)):
                     _merge_frag(detail, sec_ecrecover_device())
             except Exception as e:
                 detail["ecrecover_device_error"] = repr(e)[:200]

@@ -172,6 +172,19 @@ def main(argv=None) -> int:
         from phant_tpu.engine_api.server import serve_metrics
 
         metrics_server = serve_metrics(host=args.host, port=args.metrics_port)
+    # SIGTERM (orchestrator stop, driver timeout) leaves a postmortem: dump
+    # the obs flight ring to build/flight/, then take the same graceful
+    # shutdown path as ^C (drain the scheduler, release the socket)
+    import signal
+
+    from phant_tpu.obs import flight
+
+    def _on_sigterm(_signum, _frame):
+        flight.dump("sigterm")
+        raise KeyboardInterrupt
+
+    signal.signal(signal.SIGTERM, _on_sigterm)
+
     try:
         # --trace-logdir wraps the whole serving run in the JAX profiler
         # (no-op without the flag) so TPU kernel dispatches of served

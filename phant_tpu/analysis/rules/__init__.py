@@ -15,6 +15,7 @@ from phant_tpu.analysis.rules.hostsync import HostSyncRule
 from phant_tpu.analysis.rules.jithygiene import JitHygieneRule
 from phant_tpu.analysis.rules.lock import LockRule
 from phant_tpu.analysis.rules.metricname import MetricNameRule
+from phant_tpu.analysis.rules.spanname import SpanNameRule
 
 ALL_RULES = [
     HostSyncRule,
@@ -22,6 +23,7 @@ ALL_RULES = [
     JitHygieneRule,
     LockRule,
     MetricNameRule,
+    SpanNameRule,
 ]
 
 

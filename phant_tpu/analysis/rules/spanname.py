@@ -1,0 +1,190 @@
+"""SPANNAME: the METRICNAME gate for the TRACE vocabulary.
+
+Span names (`span("verify_block", ...)`, utils/trace.py) and flight-event
+kinds (`flight.record("sched.admit", ...)`, phant_tpu/obs/flight.py) are
+exactly as dashboard-visible as metric families — a misspelled or
+undocumented name silently forks the trace vocabulary. This rule holds
+them to the METRICNAME discipline against the `SPAN_HELP` catalog (the
+module that defines it — utils/trace.py in this repo; fixture packages in
+tests carry their own):
+
+  * S1 — a `span(...)` / `flight.record(...)` call whose name/kind is not
+    a string literal: dynamic names are invisible to this gate (annotate
+    the rare legitimate site).
+  * S2 — a literal name that is not `[a-z0-9_.]+` (keeps span names
+    joinable with the dotted metric namespace).
+  * S3 — a literal name with no `SPAN_HELP` entry: every span/event kind
+    documents itself or the gate is red.
+  * S4 — catalog rot: a `SPAN_HELP` key that appears nowhere in the
+    package as a string literal is a dead catalog entry.
+
+Call-site resolution mirrors METRICNAME: `span` resolved through imports
+to the catalog module's `span`, and `.record(...)` on a name resolving to
+the obs flight singleton (`<...>.flight.flight` or a bare `flight`).
+Internal pass-through calls inside the catalog module and `self.record`
+inside the recorder implementation are not registry calls.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, Iterator, Optional, Set, Tuple
+
+from phant_tpu.analysis.core import Finding, Rule, iter_calls
+from phant_tpu.analysis.rules._taint import snippet
+from phant_tpu.analysis.symbols import ModuleInfo, Project, _dotted
+
+_NAME_RE = re.compile(r"^[a-z0-9_.]+$")
+
+
+class SpanNameRule(Rule):
+    name = "SPANNAME"
+    description = "span/flight-event names: literal, sanitizable, and in SPAN_HELP"
+
+    def run(self, project: Project) -> Iterator[Finding]:
+        catalog = self._find_catalog(project)
+        if catalog is None:
+            return
+        cat_module, help_node, keys = catalog
+        used: Set[str] = set()
+        for mi in project.modules.values():
+            in_catalog = mi.name == cat_module.name
+            for node in ast.walk(mi.tree):
+                if (
+                    isinstance(node, ast.Constant)
+                    and isinstance(node.value, str)
+                    and not self._inside(help_node, node, in_catalog)
+                ):
+                    used.add(node.value)
+            if in_catalog:
+                continue  # the tracer implementation passes names through
+            yield from self._check_sites(project, mi, cat_module.name, keys)
+        for key, lineno in sorted(keys.items()):
+            if key not in used:
+                yield Finding(
+                    rule=self.name,
+                    path=self._rel(cat_module),
+                    line=lineno,
+                    col=1,
+                    message=(
+                        f"SPAN_HELP entry {key!r} is never emitted anywhere "
+                        "in the package — dead catalog entry (or the emit "
+                        "site builds the name dynamically: make it literal)"
+                    ),
+                    context=f"{cat_module.name}.SPAN_HELP",
+                )
+
+    @staticmethod
+    def _rel(mi: ModuleInfo) -> str:
+        from phant_tpu.analysis.core import rel_path
+
+        return rel_path(mi.path)
+
+    @staticmethod
+    def _inside(help_node: ast.AST, node: ast.AST, same_module: bool) -> bool:
+        if not same_module:
+            return False
+        return (
+            getattr(node, "lineno", 0) >= help_node.lineno
+            and getattr(node, "end_lineno", 0) <= (help_node.end_lineno or 0)
+        )
+
+    def _find_catalog(
+        self, project: Project
+    ) -> Optional[Tuple[ModuleInfo, ast.AST, Dict[str, int]]]:
+        for mi in project.modules.values():
+            for node in mi.tree.body:
+                target = None
+                if isinstance(node, ast.Assign) and len(node.targets) == 1:
+                    target, value = node.targets[0], node.value
+                elif isinstance(node, ast.AnnAssign):
+                    target, value = node.target, node.value
+                else:
+                    continue
+                if (
+                    isinstance(target, ast.Name)
+                    and target.id == "SPAN_HELP"
+                    and isinstance(value, ast.Dict)
+                ):
+                    keys = {
+                        k.value: k.lineno
+                        for k in value.keys
+                        if isinstance(k, ast.Constant) and isinstance(k.value, str)
+                    }
+                    return mi, node, keys
+        return None
+
+    def _check_sites(
+        self, project: Project, mi: ModuleInfo, cat_module: str, keys: Dict[str, int]
+    ) -> Iterator[Finding]:
+        for call in iter_calls(mi.tree):
+            name_arg = self._span_name_arg(mi, call, cat_module)
+            if name_arg is None:
+                continue
+            if not (
+                isinstance(name_arg, ast.Constant)
+                and isinstance(name_arg.value, str)
+            ):
+                yield self.finding(
+                    project,
+                    mi,
+                    call,
+                    f"`{snippet(call)}` uses a non-literal span/event name — "
+                    "dynamic names defeat the static trace-vocabulary gate",
+                    context=mi.name,
+                )
+                continue
+            name = name_arg.value
+            if not _NAME_RE.match(name):
+                yield self.finding(
+                    project,
+                    mi,
+                    call,
+                    f"span/event name {name!r} is not [a-z0-9_.]+ — keep the "
+                    "trace vocabulary joinable with the metric namespace",
+                    context=mi.name,
+                )
+            if name not in keys:
+                yield self.finding(
+                    project,
+                    mi,
+                    call,
+                    f"span/event name {name!r} has no SPAN_HELP entry — add "
+                    "its help string to the trace-vocabulary catalog",
+                    context=mi.name,
+                )
+
+    def _span_name_arg(
+        self, mi: ModuleInfo, call: ast.Call, cat_module: str
+    ) -> Optional[ast.AST]:
+        """The name argument of a span()/flight.record() call — positional
+        OR `name=`/`kind=` keyword — else None for non-registry calls. A
+        registry call with no locatable name yields the call node itself,
+        which is non-literal and so flags as S1."""
+        func = call.func
+        is_registry = False
+        keyword = "name"
+        if isinstance(func, ast.Name):
+            is_registry = mi.imports.get(func.id) == f"{cat_module}.span"
+        elif isinstance(func, ast.Attribute):
+            d = _dotted(func.value)
+            if d is not None:
+                head, _, rest = d.partition(".")
+                full = mi.imports.get(head, head) + ("." + rest if rest else "")
+                if func.attr == "span":
+                    # trace.span(...) attribute form
+                    is_registry = full == cat_module or d == "trace"
+                elif func.attr == "record":
+                    # the obs flight singleton: `flight.record(...)` via
+                    # `from ...obs.flight import flight` (or a bare name)
+                    keyword = "kind"
+                    is_registry = full.endswith(".flight.flight") or d == "flight"
+        if not is_registry:
+            return None
+        if call.args:
+            return call.args[0]
+        for kw in call.keywords:
+            if kw.arg == keyword:
+                return kw.value
+        return call

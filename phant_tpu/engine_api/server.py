@@ -23,9 +23,14 @@ Request execution goes through the continuous-batching scheduler
 Observability surface: `GET /metrics` serves the process metrics registry
 as Prometheus text exposition, `GET /healthz` a JSON liveness probe that
 includes the scheduler state (queue depth, executor liveness) and turns
-503 when the executor has died; every POST is counted,
-latency-histogrammed, and gauge-tracked in flight
-(phant_tpu/utils/trace.py). `serve_metrics()` runs the same two GET
+503 when the executor has died; `GET /debug/flight` serves the obs flight
+recorder's ring (recent spans / errors / scheduler transitions) live, and
+the first `/healthz` flip to 503 auto-dumps the same ring to
+`build/flight/` (phant_tpu/obs/). Every POST runs inside its own trace
+context — the `trace_id` rides the scheduler jobs and span records the
+request creates, and is echoed back in the `X-Phant-Trace` response
+header — and is counted, latency-histogrammed, and gauge-tracked in
+flight (phant_tpu/utils/trace.py). `serve_metrics()` runs the same GET
 endpoints standalone for `--metrics-port` deployments where the Engine API
 port is CL-only."""
 
@@ -38,6 +43,7 @@ import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from phant_tpu.engine_api import handle_request
+from phant_tpu.obs import flight
 from phant_tpu.serving import (
     SchedulerConfig,
     SchedulerError,
@@ -46,7 +52,7 @@ from phant_tpu.serving import (
     install,
     uninstall,
 )
-from phant_tpu.utils.trace import metrics
+from phant_tpu.utils.trace import current_trace_id, metrics, trace_context
 
 log = logging.getLogger("phant_tpu.engine_api")
 
@@ -58,12 +64,22 @@ _START_MONOTONIC = time.monotonic()
 _SERIAL_METHOD_PREFIXES = ("engine_newPayload", "engine_forkchoiceUpdated")
 
 
+#: the scheduler instance whose death already triggered a healthz-503 dump
+#: (flip detection is per SCHEDULER, not per process: a later server's own
+#: first 503 must still dump, and healthy scrapes clear the latch)
+_healthz_dumped_for = None
+_healthz_lock = threading.Lock()
+
+
 def _healthz_payload() -> tuple:
     """(http_status, payload): liveness plus scheduler state. A dead
     scheduler executor means the node can no longer execute payloads, so
-    the probe reports 503 — orchestrators must restart, not route."""
+    the probe reports 503 — orchestrators must restart, not route — and
+    the FIRST flip to 503 dumps the flight ring (the postmortem the
+    restart would otherwise destroy)."""
     from phant_tpu.version import RELEASE, revision
 
+    global _healthz_dumped_for
     payload = {
         "status": "ok",
         "version": RELEASE,
@@ -78,6 +94,15 @@ def _healthz_payload() -> tuple:
         if not st["executor_alive"]:
             payload["status"] = "unhealthy"
             status = 503
+    with _healthz_lock:
+        if status == 503:
+            flipped = sched is not _healthz_dumped_for
+            _healthz_dumped_for = sched
+        else:
+            flipped = False
+            _healthz_dumped_for = None
+    if flipped:
+        flight.dump("healthz_503")
     return status, payload
 
 
@@ -97,6 +122,18 @@ class _ObservableHandler(BaseHTTPRequestHandler):
         elif path == "/healthz":
             status, payload = _healthz_payload()
             self._reply(status, payload)
+        elif path == "/debug/flight":
+            # the live flight ring: what a postmortem dump would contain,
+            # readable from a still-running server (default=str: span attrs
+            # are caller-provided and may not all be JSON-native)
+            self._reply_raw(
+                200,
+                json.dumps(
+                    {"capacity": flight.capacity, "records": flight.records()},
+                    default=str,
+                ).encode(),
+                "application/json",
+            )
         else:
             self._reply(404, {"error": "not found"})
 
@@ -111,6 +148,11 @@ class _ObservableHandler(BaseHTTPRequestHandler):
             self.send_response(status)
             self.send_header("Content-Type", content_type)
             self.send_header("Content-Length", str(len(raw)))
+            tid = current_trace_id()
+            if tid is not None:
+                # the request's identity, joinable against span records,
+                # flight events, and the batch that served it
+                self.send_header("X-Phant-Trace", tid)
             self.end_headers()
             self.wfile.write(raw)
         except (BrokenPipeError, ConnectionResetError) as e:
@@ -167,7 +209,11 @@ class EngineAPIServer:
                 # disable annotation, is the audit record.
                 metrics.gauge_add("engine_api.inflight", 1)
                 try:
-                    self._handle_post()
+                    # one trace context per request: the trace_id rides
+                    # every span this thread opens and every scheduler job
+                    # it submits, and comes back in X-Phant-Trace
+                    with trace_context():
+                        self._handle_post()
                 finally:
                     metrics.gauge_add("engine_api.inflight", -1)
                     metrics.observe_hist(

@@ -1,0 +1,45 @@
+"""Request-scoped tracing and postmortem layer (on top of utils/trace.py).
+
+PR 1 gave the process metrics and per-block spans; PR 3 gave the
+continuous-batching scheduler. What was still missing is REQUEST identity
+across the scheduler boundary — nothing tied an
+`engine_executeStatelessPayloadV1` call to the queue wait, bucket, batch,
+and device dispatch that served it — and any postmortem when the process
+died. This package is that layer:
+
+* **Trace context** (`utils/trace.py trace_context`): the Engine API
+  server opens one per POST; the span a request opens and the scheduler
+  jobs it submits all carry the request's `trace_id`. The scheduler
+  attaches a batch record (`batch_id`, `queue_wait_ms`, `bucket_bytes`,
+  `batch_size`, `backend`, cache hit/miss counts) to every job it
+  executes, and `stateless.verify_witness_nodes` folds it into the
+  request's top-level span — concurrent requests coalesced into one batch
+  each get their own span linked by the shared `batch_id`.
+* **Flight recorder** (`flight.py`): a bounded thread-safe ring of span /
+  error / scheduler-transition records, served live at `GET /debug/flight`
+  and dumped to `build/flight/` on executor crash, on `/healthz` flipping
+  to 503, and on SIGTERM.
+* **Watchdog** (`watchdog.py`): detects the executor stalling inside a
+  batch (deadline overrun without a crash) and records it as a metric +
+  flight event.
+
+Importing this package registers the flight recorder as a span sink, so
+any module that touches obs gets span mirroring for free; the registration
+is idempotent.
+"""
+
+from __future__ import annotations
+
+from phant_tpu.obs.flight import FlightRecorder, flight
+from phant_tpu.obs.watchdog import Watchdog
+from phant_tpu.utils.trace import add_span_sink
+
+__all__ = ["FlightRecorder", "Watchdog", "flight", "record_span"]
+
+
+def record_span(record: dict) -> None:
+    """The span sink: mirror every top-level span record into the ring."""
+    flight.record("span", span=record)
+
+
+add_span_sink(record_span)

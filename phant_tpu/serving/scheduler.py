@@ -48,10 +48,23 @@ spans through the identical admission/assembly/executor code and get an
 (n,) bool verdict array back — the batching code measured offline is the
 batching code serving traffic.
 
+Observability (phant_tpu/obs/, PR 4): every job carries the submitting
+request's `trace_id` (utils/trace.py trace_context — the Engine API server
+opens one per POST), admissions/sheds/batch transitions land in the flight
+recorder ring, and the executor attaches a per-batch record (`batch_id`,
+`batch_size`, `bucket_bytes`, `backend`, cache hit/miss deltas,
+`queue_wait_ms`) to each job it resolves — `verify_traced()` hands it back
+so the request's span stays joinable to the batch that served it. An obs
+watchdog thread per scheduler flags the in-flight batch out-living its
+deadline (`sched.watchdog_stalls` + a `sched.stall` flight event); an
+executor crash additionally dumps the ring to build/flight/ (the
+postmortem artifact a dead server leaves behind).
+
 Thread-safety: one lock (`_lock`) guards the queue and lifecycle state;
 `_cond` wraps that same lock, so every wait/notify runs under it. The
-registry's own lock never takes ours, so metric publishes cannot deadlock
-against admission (same discipline as ops/witness_engine.py).
+registry's and flight recorder's own locks never take ours, so metric and
+flight publishes cannot deadlock against admission (same discipline as
+ops/witness_engine.py).
 """
 
 from __future__ import annotations
@@ -65,7 +78,9 @@ from typing import Callable, List, Optional, Sequence, Tuple
 
 import numpy as np
 
-from phant_tpu.utils.trace import metrics
+from phant_tpu.obs.flight import flight
+from phant_tpu.obs.watchdog import Watchdog
+from phant_tpu.utils.trace import current_trace_id, metrics
 
 log = logging.getLogger("phant_tpu.serving")
 
@@ -133,6 +148,11 @@ class _Job:
     bucket: int = 0
     # serial lane
     fn: Optional[Callable] = None
+    # observability: the submitting request's trace context, and the batch
+    # record the executor attaches before resolving the future (set-then-
+    # resolve ordering means a waiter that saw result() also sees meta)
+    trace_id: Optional[str] = None
+    meta: Optional[dict] = None
 
 
 class VerificationScheduler:
@@ -156,11 +176,22 @@ class VerificationScheduler:
         self._max_wait_s = self.config.max_wait_ms / 1e3
         self._queue_depth = self.config.queue_depth
         self._engine = engine
+        # chaos drill (obs): PHANT_SCHED_CHAOS_CRASH=1 makes the FIRST
+        # witness batch crash the executor — the supported way to fire-
+        # drill the postmortem path (flight dump, /healthz 503, -32052
+        # fail-fast) against a live server / the real CLI
+        import os
+
+        self._chaos_crash = os.environ.get("PHANT_SCHED_CHAOS_CRASH") == "1"
         self._lock = threading.Lock()
         self._cond = threading.Condition(self._lock)
         self._queue: List[_Job] = []
         self._closed = False
         self._dead: Optional[BaseException] = None
+        # observability: monotone batch ids + the in-flight descriptor the
+        # obs watchdog polls (both guarded by _lock)
+        self._batch_seq = 0
+        self._inflight: Optional[dict] = None
         self.stats = {
             "requests": 0,
             "batches": 0,
@@ -174,6 +205,7 @@ class VerificationScheduler:
             target=self._run, name="phant-sched-exec", daemon=True
         )
         self._thread.start()
+        self._watchdog = Watchdog(self.inflight_state).start()
 
     # -- context manager (offline verify_many use) ---------------------------
 
@@ -184,6 +216,26 @@ class VerificationScheduler:
         self.shutdown(drain=True)
 
     # -- admission -----------------------------------------------------------
+
+    def _witness_job(
+        self,
+        root: bytes,
+        nodes: Sequence[bytes],
+        deadline_s: Optional[float],
+    ) -> _Job:
+        nodes = list(nodes)
+        nbytes = sum(map(len, nodes))
+        return _Job(
+            kind=_WITNESS,
+            future=Future(),
+            admitted=time.monotonic(),
+            deadline=self._deadline(deadline_s),
+            root=root,
+            nodes=nodes,
+            nbytes=nbytes,
+            bucket=_pow2ceil(nbytes),
+            trace_id=current_trace_id(),
+        )
 
     def submit_witness(
         self,
@@ -196,19 +248,25 @@ class VerificationScheduler:
         future resolves to the bool verdict. `wait_for_space` blocks on a
         full queue instead of rejecting (offline verify_many); the online
         serving path never waits — overload must shed, not stack."""
-        nodes = list(nodes)
-        nbytes = sum(map(len, nodes))
-        job = _Job(
-            kind=_WITNESS,
-            future=Future(),
-            admitted=time.monotonic(),
-            deadline=self._deadline(deadline_s),
-            root=root,
-            nodes=nodes,
-            nbytes=nbytes,
-            bucket=_pow2ceil(nbytes),
-        )
-        return self._admit(job, wait_for_space)
+        job = self._witness_job(root, nodes, deadline_s)
+        self._admit(job, wait_for_space)
+        return job.future
+
+    def verify_traced(
+        self,
+        root: bytes,
+        nodes: Sequence[bytes],
+        deadline_s: Optional[float] = None,
+    ) -> Tuple[bool, Optional[dict]]:
+        """One witness verification through the batching path, returning
+        (verdict, batch record). The record — `batch_id`, `batch_size`,
+        `bucket_bytes`, `backend`, cache hit/miss deltas, `queue_wait_ms` —
+        is what joins the caller's span to the shared engine dispatch that
+        served it (stateless.verify_witness_nodes folds it into the open
+        `verify_block` span). Scheduler rejections raise as usual."""
+        job = self._witness_job(root, nodes, deadline_s)
+        self._admit(job, False)
+        return bool(job.future.result()), job.meta
 
     def submit_serial(
         self, fn: Callable, deadline_s: Optional[float] = None
@@ -224,8 +282,10 @@ class VerificationScheduler:
             admitted=time.monotonic(),
             deadline=self._deadline(deadline_s),
             fn=fn,
+            trace_id=current_trace_id(),
         )
-        return self._admit(job, False)
+        self._admit(job, False)
+        return job.future
 
     def _deadline(self, deadline_s: Optional[float]) -> Optional[float]:
         if deadline_s is None:
@@ -236,7 +296,7 @@ class VerificationScheduler:
             return None
         return time.monotonic() + d
 
-    def _admit(self, job: _Job, wait_for_space: bool) -> Future:
+    def _admit(self, job: _Job, wait_for_space: bool) -> None:
         reason = None
         with self._lock:
             while True:
@@ -266,9 +326,18 @@ class VerificationScheduler:
                 self.stats["rejected"] += 1
         if reason is not None:
             metrics.count("sched.rejected", reason=reason)
+            flight.record(
+                "sched.shed", reason=reason, lane=job.kind, trace_id=job.trace_id
+            )
             raise err
         metrics.gauge_set("sched.queue_depth", depth)
-        return job.future
+        flight.record(
+            "sched.admit",
+            lane=job.kind,
+            bucket_bytes=job.bucket if job.kind == _WITNESS else None,
+            queue_depth=depth,
+            trace_id=job.trace_id,
+        )
 
     # -- the synchronous offline face ---------------------------------------
 
@@ -329,6 +398,13 @@ class VerificationScheduler:
         st["mean_batch"] = round(st["batched_requests"] / b, 2) if b else 0.0
         return st
 
+    def inflight_state(self) -> Optional[dict]:
+        """The batch the executor is inside right now — `batch_id`, `lane`,
+        `started`/`deadline` (monotonic), `trace_ids` — or None when idle.
+        Polled by the obs watchdog to flag deadline-overrun stalls."""
+        with self._lock:
+            return dict(self._inflight) if self._inflight is not None else None
+
     # -- lifecycle -----------------------------------------------------------
 
     def shutdown(self, drain: bool = True, timeout: float = 30.0) -> None:
@@ -346,6 +422,7 @@ class VerificationScheduler:
                 SchedulerDown("scheduler shut down before execution")
             )
         self._thread.join(timeout)
+        self._watchdog.stop(1.0)
         metrics.gauge_set("sched.queue_depth", 0)
 
     # -- executor ------------------------------------------------------------
@@ -410,6 +487,9 @@ class VerificationScheduler:
         with self._lock:
             self.stats["rejected"] += 1
         metrics.count("sched.rejected", reason="deadline")
+        flight.record(
+            "sched.shed", reason="deadline", lane=job.kind, trace_id=job.trace_id
+        )
         job.future.set_exception(
             DeadlineExpired("deadline expired while queued")
         )
@@ -432,31 +512,98 @@ class VerificationScheduler:
                 DeadlineExpired("deadline expired while queued")
             )
             metrics.count("sched.rejected", reason="deadline")
+            flight.record(
+                "sched.shed", reason="deadline", lane=j.kind, trace_id=j.trace_id
+            )
 
     def _execute(self, batch: List[_Job]) -> None:
         now = time.monotonic()
         for j in batch:
             metrics.observe_hist("sched.queue_wait_seconds", now - j.admitted)
-        if batch[0].kind == _SERIAL:
-            self._execute_serial(batch[0])
+        lane = batch[0].kind
+        # the stall bound the obs watchdog polls against: a full execution
+        # allowance (config.deadline_ms) from PICKUP time — never the jobs'
+        # admission deadlines, or a batch picked up with 0.2s of a 30s
+        # deadline left would flag a perfectly healthy executor as stalled
+        # and bury the real wedged-device signal
+        if self.config.deadline_ms > 0:
+            stall_deadline: Optional[float] = now + self.config.deadline_ms / 1e3
         else:
-            self._execute_witness(batch)
+            stall_deadline = None
+        trace_ids = [j.trace_id for j in batch]
+        with self._lock:
+            self._batch_seq += 1
+            batch_id = self._batch_seq
+            self._inflight = {
+                "batch_id": batch_id,
+                "lane": lane,
+                "started": now,
+                "deadline": stall_deadline,
+                "trace_ids": trace_ids,
+            }
+        flight.record(
+            "sched.batch_start",
+            batch_id=batch_id,
+            lane=lane,
+            batch_size=len(batch),
+            bucket_bytes=batch[0].bucket if lane == _WITNESS else None,
+            trace_ids=trace_ids,
+        )
+        try:
+            if lane == _SERIAL:
+                self._execute_serial(batch[0], batch_id)
+            else:
+                self._execute_witness(batch, batch_id)
+        finally:
+            with self._lock:
+                self._inflight = None
 
-    def _execute_serial(self, job: _Job) -> None:
+    def _execute_serial(self, job: _Job, batch_id: int) -> None:
         metrics.count("sched.batches", lane="serial")
         with self._lock:
             self.stats["serial_jobs"] += 1
         if job.deadline is not None and time.monotonic() > job.deadline:
             self._shed_expired(job)
             return
+        t0 = time.monotonic()
+
+        def done(ok: bool, **extra) -> None:
+            # the postmortem must distinguish a failed mutation from a
+            # successful one — `ok` is the serial lane's n_ok analog
+            flight.record(
+                "sched.batch_done",
+                batch_id=batch_id,
+                lane=_SERIAL,
+                batch_size=1,
+                ok=ok,
+                duration_ms=round((time.monotonic() - t0) * 1e3, 3),
+                queue_wait_ms=round((t0 - job.admitted) * 1e3, 3),
+                trace_ids=[job.trace_id],
+                **extra,
+            )
+
         try:
             result = job.fn()
         except Exception as e:  # request-scoped: the job failed, not us
+            done(False, error=repr(e)[:160])
             job.future.set_exception(e)
             return
+        done(True)
         job.future.set_result(result)
 
-    def _execute_witness(self, batch: List[_Job]) -> None:
+    @staticmethod
+    def _engine_cache_stats(engine) -> Optional[dict]:
+        """hits/hashed/device/native counters of the engine, or None when
+        the engine exposes no stats (custom test doubles)."""
+        snap = getattr(engine, "stats_snapshot", None)
+        if snap is None:
+            return None
+        try:
+            return snap()
+        except Exception:
+            return None
+
+    def _execute_witness(self, batch: List[_Job], batch_id: int) -> None:
         now = time.monotonic()
         jobs = []
         for j in batch:
@@ -469,17 +616,54 @@ class VerificationScheduler:
         n = len(jobs)
         total = sum(j.nbytes for j in jobs)
         padded = _pow2ceil(total)
+        if self._chaos_crash:
+            raise RuntimeError(
+                "chaos drill: PHANT_SCHED_CHAOS_CRASH=1 induced executor crash"
+            )
+        engine = self._resolve_engine()
+        s0 = self._engine_cache_stats(engine)
         # the engine/device dispatch this scheduler exists for: one
         # verify_batch over the whole coalesced bucket. An exception here
         # is systemic (malformed witnesses yield False verdicts, and the
         # engine falls back device->native internally), so it propagates
         # to _run and takes the executor down — requests fail fast rather
         # than silently retrying into a broken engine.
-        verdicts = self._resolve_engine().verify_batch(
-            [(j.root, j.nodes) for j in jobs]
-        )
+        verdicts = engine.verify_batch([(j.root, j.nodes) for j in jobs])
+        s1 = self._engine_cache_stats(engine)
+        record = {
+            "batch_id": batch_id,
+            "batch_size": n,
+            "bucket_bytes": jobs[0].bucket,
+        }
+        if s0 is not None and s1 is not None:
+            # deltas are batch-attributable as long as this executor is the
+            # engine's only concurrent caller (the serving configuration);
+            # a shared offline engine can skew them by other callers' work
+            record["cache_hits"] = s1.get("hits", 0) - s0.get("hits", 0)
+            record["cache_misses"] = s1.get("hashed", 0) - s0.get("hashed", 0)
+            if s1.get("device_batches", 0) > s0.get("device_batches", 0):
+                record["backend"] = "device"
+            elif s1.get("native_batches", 0) > s0.get("native_batches", 0):
+                record["backend"] = "native"
+            else:
+                record["backend"] = "cached"  # zero novel nodes: no hashing
+        done = time.monotonic()
         for j, ok in zip(jobs, verdicts):
+            # meta BEFORE set_result: a waiter that observed the verdict
+            # must also observe its batch record (verify_traced)
+            j.meta = {
+                **record,
+                "queue_wait_ms": round((now - j.admitted) * 1e3, 3),
+            }
             j.future.set_result(bool(ok))
+        flight.record(
+            "sched.batch_done",
+            lane=_WITNESS,
+            duration_ms=round((done - now) * 1e3, 3),
+            n_ok=int(sum(bool(ok) for ok in verdicts)),
+            trace_ids=[j.trace_id for j in jobs],
+            **record,
+        )
         metrics.observe_hist("sched.batch_size", n, buckets=_BATCH_BUCKETS)
         metrics.count("sched.batches", lane="witness")
         metrics.gauge_set(
@@ -510,10 +694,23 @@ class VerificationScheduler:
             self._dead = exc
             victims = batch + self._queue
             self._queue = []
+            batch_id = self._batch_seq
             self._cond.notify_all()
+        # the postmortem FIRST: record the crash (with the crashing batch's
+        # ids) and dump the whole ring to build/flight/ — by the time a
+        # waiter observes its SchedulerDown, the artifact already exists
+        flight.record(
+            "sched.executor_crash",
+            batch_id=batch_id,
+            error=repr(exc),
+            crashed_trace_ids=[j.trace_id for j in batch],
+            n_failed_fast=len(victims),
+        )
+        flight.dump("executor_crash")
         for j in victims:
             if not j.future.done():
                 j.future.set_exception(
                     SchedulerDown(f"scheduler executor crashed: {exc!r}")
                 )
         metrics.gauge_set("sched.queue_depth", 0)
+        self._watchdog.stop(0.0)

@@ -501,10 +501,14 @@ def verify_witness_nodes(state_root: bytes, nodes: List[bytes]) -> bool:
     (phant_tpu/serving/ — the Engine API server installs one), the check
     routes through it so concurrent handler threads coalesce into ONE
     `verify_batch` engine/device dispatch instead of paying a batch-of-1
-    each. Scheduler rejections (queue full, deadline, executor down)
-    propagate as SchedulerError for the server to map to JSON-RPC errors.
-    Without a scheduler — offline tools, tests, the spec runner by
-    default — the direct shared-engine path is unchanged."""
+    each. The batch record the executor attaches (batch_id, batch_size,
+    bucket_bytes, backend, cache hit/miss, queue_wait_ms) folds into the
+    caller's open span, so the request's `verify_block` trace names the
+    shared dispatch that served it (phant_tpu/obs/). Scheduler rejections
+    (queue full, deadline, executor down) propagate as SchedulerError for
+    the server to map to JSON-RPC errors. Without a scheduler — offline
+    tools, tests, the spec runner by default — the direct shared-engine
+    path is unchanged."""
     if state_root == EMPTY_TRIE_ROOT:
         # the empty pre-state needs (and admits) no witness nodes — same
         # contract as the host BFS (mpt/proof.py verify_witness_linked)
@@ -515,7 +519,14 @@ def verify_witness_nodes(state_root: bytes, nodes: List[bytes]) -> bool:
 
     sched = active_scheduler()
     if sched is not None and sched.accepts_witness():
-        return bool(sched.submit_witness(state_root, nodes).result())
+        ok, meta = sched.verify_traced(state_root, nodes)
+        if meta is not None:
+            from phant_tpu.utils.trace import current_span
+
+            sp = current_span()
+            if sp is not None:
+                sp.attrs.update(meta)
+        return ok
     return shared_witness_engine().verify(state_root, nodes)
 
 
@@ -573,6 +584,17 @@ def execute_stateless(
         except Exception as e:
             # by-kind counter (bounded cardinality: exception class names)
             metrics.count("stateless.errors", kind=type(e).__name__)
+            # and an error record in the flight ring: a postmortem dump
+            # carries the failing block + reason, not just a count
+            from phant_tpu.obs.flight import flight
+
+            flight.record(
+                "error",
+                where="stateless.execute_stateless",
+                error_kind=type(e).__name__,
+                error=str(e)[:240],
+                block=block.header.block_number,
+            )
             raise
         metrics.count("stateless.blocks_verified")
         return result, post_root

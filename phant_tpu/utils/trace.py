@@ -14,6 +14,13 @@ slot (SURVEY §5) to:
 - `span(name, **attrs)` — a thread-safe (thread-local-stacked) per-block
   span tracer: every top-level span emits ONE structured-JSON log line
   carrying its duration, its nested phase timings, and any child spans,
+- `trace_context(trace_id)` / `current_trace_id()` — a per-thread request
+  identity: the Engine API server opens a context per POST and every span
+  opened inside it (on that thread) carries the `trace_id`, so a request's
+  span record stays joinable to the scheduler batch that served it even
+  after coalescing (phant_tpu/obs/ holds the flight-recorder side),
+- `add_span_sink(fn)` — top-level span records additionally fan out to
+  registered sinks (the obs flight recorder registers one),
 - `jax_profile(logdir)` — a context manager around the JAX profiler for
   device traces of the TPU kernels,
 - `scoped_logger(scope)` — the reference's scoped-logger idiom.
@@ -158,6 +165,9 @@ METRIC_HELP: Dict[str, str] = {
     "sched.batches": "Scheduler executions by lane (witness batches / serial jobs)",
     "sched.padding_waste": "Unused fraction of the padded device buffer the last witness batch would occupy",
     "sched.executor_crashes": "Scheduler executor crashes (scheduler marked down, /healthz -> 503)",
+    # observability layer (phant_tpu/obs/)
+    "sched.watchdog_stalls": "Executor stalls detected by the obs watchdog (in-flight batch past its deadline)",
+    "flight.dumps": "Flight-recorder postmortem dumps written, by trigger reason",
     # crypto backend dispatch
     "keccak.batches": "Batched keccak dispatches by backend",
     "keccak.bytes": "Payload bytes submitted to batched keccak by backend",
@@ -165,6 +175,27 @@ METRIC_HELP: Dict[str, str] = {
     "keccak.host_readback": "Device->host digest readback (the honest sync) phase",
     "backend.selected": "Crypto-backend selections by backend (process start + bench flips)",
     "backend.offload_decisions": "Adaptive offload-gate verdicts by outcome (device/native)",
+}
+
+
+#: the trace vocabulary: every `span(name, ...)` name and every flight-event
+#: kind (`flight.record(kind, ...)`, phant_tpu/obs/flight.py) must have an
+#: entry here — phantlint's SPANNAME rule enforces it exactly the way
+#: METRICNAME enforces METRIC_HELP, so span/flight names stay literal,
+#: documented, and free of dead catalog entries.
+SPAN_HELP: Dict[str, str] = {
+    # spans (top-level records carry trace_id + the scheduler batch fields)
+    "verify_block": "One stateless payload execution: witness_verify/witness_decode/execute/post_root phases plus the serving batch fields (batch_id, queue_wait_ms, ...)",
+    # flight-event kinds (phant_tpu/obs/flight.py ring records)
+    "span": "A completed top-level span record (mirrored from the span sink)",
+    "error": "An exception record (stateless execution aborts and other instrumented failures)",
+    "sched.admit": "A request admitted to the scheduler queue",
+    "sched.shed": "A request shed at admission or execution time (queue_full/deadline/down/shutdown)",
+    "sched.batch_start": "The executor picked up a batch (witness lane) or serial job",
+    "sched.batch_done": "A batch/serial job finished; carries the batch record (size, bucket, backend, cache counts, trace ids)",
+    "sched.executor_crash": "The scheduler executor died; carries the crashing batch's ids",
+    "sched.stall": "The obs watchdog found the in-flight batch past its deadline",
+    "flight.dump": "A postmortem dump was written to disk (reason + path)",
 }
 
 
@@ -376,6 +407,53 @@ def phase(name: str):
 _span_log = logging.getLogger("phant_tpu.span")
 _span_tls = threading.local()
 
+#: top-level span records (dicts) fan out here in addition to the log line;
+#: the obs flight recorder registers a sink (phant_tpu/obs/__init__.py).
+#: Mutated only via add/remove below; iteration reads a snapshot reference.
+_span_sinks: List = []
+
+
+def add_span_sink(fn) -> None:
+    """Register `fn(record: dict)` to receive every TOP-LEVEL span record.
+    Idempotent per function object. Sinks must be fast and non-raising
+    (exceptions are swallowed: tracing must never fail the traced work)."""
+    if fn not in _span_sinks:
+        _span_sinks.append(fn)
+
+
+def remove_span_sink(fn) -> None:
+    if fn in _span_sinks:
+        _span_sinks.remove(fn)
+
+
+def new_trace_id() -> str:
+    """16-hex-char request identity (collision-safe at serving volumes)."""
+    import os as _os
+
+    return _os.urandom(8).hex()
+
+
+def current_trace_id() -> Optional[str]:
+    """The trace id of the innermost open trace_context on this thread."""
+    stack = getattr(_span_tls, "trace_ids", None)
+    return stack[-1] if stack else None
+
+
+@contextlib.contextmanager
+def trace_context(trace_id: Optional[str] = None) -> Iterator[str]:
+    """Bind a request identity to the current thread: spans opened inside
+    (and scheduler submissions made inside, phant_tpu/serving/) carry this
+    `trace_id`. Nests; the Engine API server opens one per POST."""
+    tid = trace_id or new_trace_id()
+    stack = getattr(_span_tls, "trace_ids", None)
+    if stack is None:
+        stack = _span_tls.trace_ids = []
+    stack.append(tid)
+    try:
+        yield tid
+    finally:
+        stack.pop()
+
 
 class Span:
     """One traced operation: wall-clock duration + the phase timings that
@@ -426,7 +504,13 @@ def span(name: str, **attrs) -> Iterator[Span]:
     to the innermost open span of the current thread. A nested span folds
     its summary into its parent; each TOP-LEVEL span emits one
     structured-JSON log line (logger `phant_tpu.span`, INFO) with the
-    nested phase timings — the per-block trace record."""
+    nested phase timings — the per-block trace record — and fans the same
+    record out to registered span sinks (the obs flight recorder). A span
+    opened inside a `trace_context` carries its `trace_id`."""
+    if "trace_id" not in attrs:
+        tid = current_trace_id()
+        if tid is not None:
+            attrs["trace_id"] = tid
     sp = Span(name, attrs)
     stack = getattr(_span_tls, "stack", None)
     if stack is None:
@@ -440,10 +524,20 @@ def span(name: str, **attrs) -> Iterator[Span]:
         stack.pop()
         if stack:
             stack[-1].children.append(sp.to_dict())
-        elif _span_log.isEnabledFor(logging.INFO):
-            # serialization is per-block work on the serving hot path —
-            # skip it entirely when nobody listens
-            _span_log.info(json.dumps(sp.to_dict(), default=str))
+        else:
+            sinks = tuple(_span_sinks)  # snapshot: a concurrent
+            # remove_span_sink must not shift the list mid-iteration
+            if sinks or _span_log.isEnabledFor(logging.INFO):
+                # serialization is per-block work on the serving hot path —
+                # skip it entirely when nobody listens
+                record = sp.to_dict()
+                for sink in sinks:
+                    try:
+                        sink(record)
+                    except Exception:  # tracing must never fail the work
+                        pass
+                if _span_log.isEnabledFor(logging.INFO):
+                    _span_log.info(json.dumps(record, default=str))
 
 
 @contextlib.contextmanager

@@ -1,0 +1,254 @@
+#!/usr/bin/env python
+"""benchtrend: the regression sentinel over the committed bench artifacts.
+
+The repo accumulates one `BENCH_rNN.json` (and one `MULTICHIP_rNN.json`)
+per growth round, but until now they were dead files — nothing compared
+round N against rounds 1..N-1, so a silent 3x regression (or a round that
+produced NO artifact at all, BENCH_r05) only surfaced if a human went
+digging. `make trend` turns them into a trajectory:
+
+  * every numeric metric in `parsed.detail` (plus the headline `value`)
+    is aligned by key across rounds;
+  * the latest round's value is compared against the MEDIAN of the prior
+    rounds, with a NOISE-AWARE threshold: the flag bar is
+    max(--threshold, prior relative spread) — a metric that historically
+    swings 3x between identical runs (the shared box does that; see
+    CHANGES PR 2) cannot alarm on noise, while a historically-stable
+    metric alarms on a modest drop;
+  * direction comes from the key: `*_per_sec` higher-is-better,
+    `*_ms`/`*_seconds` lower-is-better, everything else informational;
+  * a latest round whose artifact is missing/unparseable (`parsed: null`,
+    rc != 0) is itself a flagged finding — a dead artifact is the worst
+    regression of all (that IS the r05 failure);
+  * MULTICHIP artifacts contribute an ok/rc health row.
+
+Exit status: 1 when anything is flagged, 0 otherwise; `--report-only`
+always exits 0 (scripts/check.sh runs that mode so the commit gate shows
+the trend without going red on box noise — the driver-side consumer can
+run the strict mode).
+
+Usage:
+    python scripts/benchtrend.py [--dir .] [--threshold 0.4]
+                                 [--min-prior 2] [--report-only] [--json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import re
+import sys
+from statistics import median
+from typing import Dict, List, Optional, Tuple
+
+_BENCH_RE = re.compile(r"^BENCH_r(\d+)\.json$")
+_MULTI_RE = re.compile(r"^MULTICHIP_r(\d+)\.json$")
+
+#: metrics never flagged (shape/config echoes, not performance)
+_INFO_SUFFIXES = (
+    "_batch", "_blocks", "_accounts", "_txs_per_block", "_per_block",
+    "_attempts", "_seconds_budget",
+)
+
+
+def _direction(key: str) -> Optional[str]:
+    """'up' = higher is better, 'down' = lower is better, None = info."""
+    if key.endswith(_INFO_SUFFIXES):
+        return None
+    if key.endswith("_per_sec") or key.endswith("_mbps") or key == "value":
+        return "up"
+    if key.endswith("_ms") or key.endswith("_seconds") or key.endswith("_s"):
+        return "down"
+    return None
+
+
+def load_rounds(dirpath: str, pattern: re.Pattern) -> List[Tuple[int, dict]]:
+    out = []
+    for fn in os.listdir(dirpath):
+        m = pattern.match(fn)
+        if not m:
+            continue
+        try:
+            with open(os.path.join(dirpath, fn)) as f:
+                out.append((int(m.group(1)), json.load(f)))
+        except (OSError, json.JSONDecodeError):
+            out.append((int(m.group(1)), {}))
+    return sorted(out)
+
+
+def _series(rounds: List[Tuple[int, dict]]) -> Dict[str, List[Tuple[int, float]]]:
+    """metric key -> [(round, value), ...] across every parsed artifact."""
+    series: Dict[str, List[Tuple[int, float]]] = {}
+    for n, rec in rounds:
+        parsed = rec.get("parsed")
+        if not isinstance(parsed, dict):
+            continue
+        flat = {"value": parsed.get("value")}
+        detail = parsed.get("detail") or {}
+        for k, v in detail.items():
+            flat[k] = v
+        for k, v in flat.items():
+            if isinstance(v, (int, float)) and not isinstance(v, bool):
+                series.setdefault(k, []).append((n, float(v)))
+    return series
+
+
+def analyze(
+    dirpath: str, threshold: float, min_prior: int
+) -> Tuple[List[dict], List[str]]:
+    """(rows, flags): the per-metric trend table and the flagged findings."""
+    rounds = load_rounds(dirpath, _BENCH_RE)
+    flags: List[str] = []
+    rows: List[dict] = []
+    if not rounds:
+        return rows, flags
+    latest_n, latest_rec = rounds[-1]
+
+    # artifact health first: a round with no parseable artifact is the
+    # regression that hides every other one (BENCH_r05: rc=124, parsed null)
+    if not isinstance(latest_rec.get("parsed"), dict):
+        flags.append(
+            f"BENCH_r{latest_n:02d}: no parseable artifact "
+            f"(rc={latest_rec.get('rc')}, parsed="
+            f"{'null' if latest_rec.get('parsed') is None else 'invalid'}) — "
+            "the round produced NO bench data"
+        )
+
+    # metric comparisons run against the newest round that HAS data (when
+    # the newest round's artifact is dead, the health flag above already
+    # covers it — the trend table should still show the last real numbers)
+    parsed_ns = [n for n, rec in rounds if isinstance(rec.get("parsed"), dict)]
+    eval_n = parsed_ns[-1] if parsed_ns else latest_n
+
+    series = _series(rounds)
+    for key in sorted(series):
+        pts = series[key]
+        latest = next((v for n, v in pts if n == eval_n), None)
+        prior = [v for n, v in pts if n != eval_n]
+        direction = _direction(key)
+        row = {
+            "metric": key,
+            "rounds": len(pts),
+            "latest": latest,
+            "direction": direction or "info",
+        }
+        if latest is None or direction is None or len(prior) < min_prior:
+            row["verdict"] = "n/a" if direction is None else "insufficient-history"
+            rows.append(row)
+            continue
+        base = median(prior)
+        if base == 0:
+            row["verdict"] = "n/a"
+            rows.append(row)
+            continue
+        spread = (max(prior) - min(prior)) / abs(base) if len(prior) > 1 else 0.0
+        bar = max(threshold, spread)
+        delta = (latest - base) / abs(base)
+        worse = -delta if direction == "up" else delta
+        row.update(
+            prior_median=round(base, 2),
+            delta_pct=round(delta * 100, 1),
+            noise_bar_pct=round(bar * 100, 1),
+        )
+        if worse > bar:
+            row["verdict"] = "REGRESSED"
+            flags.append(
+                f"{key}: {latest:g} vs prior median {base:g} "
+                f"({delta * 100:+.1f}%, {'higher' if direction == 'up' else 'lower'}"
+                f"-is-better, noise bar ±{bar * 100:.0f}%)"
+            )
+        else:
+            row["verdict"] = "ok"
+        rows.append(row)
+
+    # multichip health: latest must not turn red while history was green
+    multi = load_rounds(dirpath, _MULTI_RE)
+    if multi:
+        mn, mrec = multi[-1]
+        ever_ok = any(r.get("ok") for _n, r in multi[:-1])
+        # a skipped round is not a regression: keep the row verdict and the
+        # strict-mode flag on the SAME condition or the report and the exit
+        # code would contradict each other
+        multi_red = not mrec.get("ok") and ever_ok and not mrec.get("skipped")
+        rows.append(
+            {
+                "metric": "multichip_ok",
+                "rounds": len(multi),
+                "latest": bool(mrec.get("ok")),
+                "direction": "up",
+                "verdict": "REGRESSED" if multi_red else "ok",
+            }
+        )
+        if multi_red:
+            flags.append(
+                f"MULTICHIP_r{mn:02d}: ok=false (rc={mrec.get('rc')}) after a "
+                "previously-green multichip round"
+            )
+    return rows, flags
+
+
+def render(rows: List[dict], flags: List[str]) -> str:
+    out = []
+    headed = [r for r in rows if r["verdict"] not in ("n/a",)]
+    if headed:
+        w = max(len(r["metric"]) for r in headed)
+        out.append(
+            f"{'metric'.ljust(w)}  {'prior-med':>12} {'latest':>12} "
+            f"{'delta':>8} {'noise':>7}  verdict"
+        )
+        for r in headed:
+            out.append(
+                f"{r['metric'].ljust(w)}  "
+                f"{str(r.get('prior_median', '-')):>12} "
+                f"{str(r.get('latest', '-')):>12} "
+                f"{(str(r['delta_pct']) + '%') if 'delta_pct' in r else '-':>8} "
+                f"{('±' + str(r['noise_bar_pct']) + '%') if 'noise_bar_pct' in r else '-':>7}  "
+                f"{r['verdict']}"
+            )
+    if flags:
+        out.append("")
+        out.append(f"FLAGGED ({len(flags)}):")
+        out.extend(f"  - {f}" for f in flags)
+    else:
+        out.append("")
+        out.append("no regressions flagged")
+    return "\n".join(out)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--dir", default=os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    p.add_argument(
+        "--threshold",
+        type=float,
+        default=0.4,
+        help="minimum relative-regression bar (raised per-metric to the "
+        "prior spread — box noise historically swings runs ±30%%+)",
+    )
+    p.add_argument(
+        "--min-prior",
+        type=int,
+        default=2,
+        help="prior rounds a metric needs before it can flag",
+    )
+    p.add_argument(
+        "--report-only",
+        action="store_true",
+        help="always exit 0 (the check.sh mode: show the trend, never gate)",
+    )
+    p.add_argument("--json", action="store_true", help="machine-readable output")
+    args = p.parse_args(argv)
+
+    rows, flags = analyze(args.dir, args.threshold, args.min_prior)
+    if args.json:
+        print(json.dumps({"rows": rows, "flags": flags}, indent=1))
+    else:
+        print(render(rows, flags))
+    if flags and not args.report_only:
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -82,12 +82,23 @@ fi
 # Scheduler soak smoke AFTER the pytest groups: a live server under
 # multi-threaded mixed traffic (serial-lane newPayloads + batching-lane
 # stateless verifications) must serialize mutation exactly once, coalesce
-# witness batches, shed nothing, and drain clean (phant_tpu/serving/).
+# witness batches, shed nothing, and drain clean (phant_tpu/serving/) —
+# then an INDUCED executor crash in a throwaway server must leave a
+# well-formed flight-recorder dump (phant_tpu/obs/).
 t0=$(date +%s)
 JAX_PLATFORMS=cpu python scripts/soak.py > build/logs/soak.log 2>&1
 rc=$?
 echo "[check] group soak: rc=$rc in $(( $(date +%s) - t0 ))s"
 if [ "$rc" -ne 0 ]; then cat build/logs/soak.log; fail=1; fi
+
+# Bench-trend sentinel, report-only: surface per-section deltas across the
+# committed BENCH_r*/MULTICHIP_r* artifacts in every gate run without
+# going red on shared-box noise (`make trend` is the strict mode).
+t0=$(date +%s)
+python scripts/benchtrend.py --report-only > build/logs/trend.log 2>&1
+rc=$?
+echo "[check] group trend (report-only): rc=$rc in $(( $(date +%s) - t0 ))s"
+tail -n 5 build/logs/trend.log | sed 's/^/[trend] /'
 
 total=$(( $(date +%s) - start ))
 if [ "$fail" -ne 0 ]; then

@@ -19,6 +19,16 @@ Pass criteria (exit 1 otherwise):
     and its executor is still alive at the end;
   * shutdown drains cleanly (no queued work abandoned, the scheduler
     slot is released).
+
+A second phase (`_crash_phase`) then INDUCES one executor crash in a
+throwaway server — a poisoned engine under a real HTTP
+executeStatelessPayloadV1 — and asserts the obs postmortem contract:
+  * pre-crash, `GET /debug/flight` serves the ring with the request's
+    admit/batch records;
+  * the crash writes a well-formed JSON dump under build/flight/ whose
+    records include the `sched.executor_crash` event AND the crashing
+    batch's trace ids (joinable to the HTTP X-Phant-Trace header);
+  * `/healthz` flips to 503 and the flip writes its own dump.
 """
 
 from __future__ import annotations
@@ -151,6 +161,95 @@ def main() -> int:
             print(f"[soak] FAIL: {f}", file=sys.stderr)
         return 1
     print("[soak] green: no errors, clean drain")
+    return _crash_phase()
+
+
+def _crash_phase() -> int:
+    """Induce one executor crash in a throwaway server; assert the flight
+    recorder leaves a joinable postmortem (the obs acceptance criterion)."""
+    import json
+    import urllib.error
+
+    from phant_tpu.engine_api.server import EngineAPIServer
+    from phant_tpu.serving import SchedulerConfig, VerificationScheduler
+
+    from test_serving import _post, _stateless_request
+
+    class _PoisonedEngine:
+        def verify_batch(self, witnesses):
+            raise RuntimeError("soak-induced crash")
+
+    flight_dir = os.environ.get(
+        "PHANT_FLIGHT_DIR",
+        os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            "build",
+            "flight",
+        ),
+    )
+    os.makedirs(flight_dir, exist_ok=True)
+    before = set(os.listdir(flight_dir))
+
+    stateless_chain, stateless_rpc, _root = _stateless_request()
+    sched = VerificationScheduler(
+        engine=_PoisonedEngine(),
+        config=SchedulerConfig(max_batch=8, max_wait_ms=10.0),
+    )
+    server = EngineAPIServer(
+        stateless_chain, host="127.0.0.1", port=0, scheduler=sched
+    )
+    server.serve_in_background()
+    base = f"http://127.0.0.1:{server.port}"
+    failures: list = []
+    try:
+        # pre-crash: the live ring is readable over HTTP
+        code, body = _get(base, "/debug/flight")
+        if code != 200:
+            failures.append(f"/debug/flight pre-crash HTTP {code}")
+        # the crash: a real stateless request whose witness check routes
+        # through the poisoned engine on the executor thread
+        code, body = _post(base, stateless_rpc)
+        if code != 503 or body.get("error", {}).get("code") != -32052:
+            failures.append(f"induced crash reply unexpected: {code} {body}")
+        # healthz flips 503 (and dumps on the flip)
+        try:
+            _get(base, "/healthz")
+            failures.append("healthz stayed 200 after executor crash")
+        except urllib.error.HTTPError as e:
+            if e.code != 503:
+                failures.append(f"healthz HTTP {e.code}, want 503")
+    finally:
+        server.shutdown()
+        sched.shutdown()
+
+    new_dumps = sorted(set(os.listdir(flight_dir)) - before)
+    crash_dumps = [d for d in new_dumps if "executor_crash" in d]
+    if not crash_dumps:
+        failures.append(f"no executor_crash flight dump written ({new_dumps})")
+    else:
+        with open(os.path.join(flight_dir, crash_dumps[0])) as f:
+            dump = json.load(f)  # must be well-formed JSON
+        kinds = [r.get("kind") for r in dump.get("records", [])]
+        crash = [
+            r for r in dump["records"] if r.get("kind") == "sched.executor_crash"
+        ]
+        if not crash:
+            failures.append(f"dump lacks sched.executor_crash record: {kinds}")
+        elif not any(crash[0].get("crashed_trace_ids") or []):
+            failures.append(f"crash record carries no trace ids: {crash[0]}")
+        if "sched.batch_start" not in kinds:
+            failures.append(f"dump lacks the crashing batch's start record: {kinds}")
+    if not any("healthz_503" in d for d in new_dumps):
+        failures.append(f"no healthz_503 flip dump written ({new_dumps})")
+
+    if failures:
+        for f in failures:
+            print(f"[soak] FAIL (crash phase): {f}", file=sys.stderr)
+        return 1
+    print(
+        f"[soak] crash phase green: {len(new_dumps)} flight dump(s), "
+        f"postmortem names the crashing batch ({crash_dumps[0]})"
+    )
     return 0
 
 

@@ -84,6 +84,53 @@ def test_bench_prints_one_json_line_with_schema(tmp_path):
 
 
 @pytest.mark.slow
+def test_bench_underruns_external_timeout_with_skipped_budget(tmp_path):
+    """The BENCH_r05 postmortem pin (parsed: null, rc=124). The driver
+    wraps bench in a SHELL under `timeout -k`, and in round 5 its window
+    (~1800s) undercut bench's internal 2400s deadline — the partial-emit
+    path could never fire before the external kill. The contract now: the
+    internal wall budget (PHANT_BENCH_GLOBAL_TIMEOUT, default 1500) stays
+    BELOW the driver window, sections that no longer fit are skipped with
+    a `skipped_budget` annotation, and the run exits 0 with ONE parseable
+    JSON line long before the external timeout — exercised here with the
+    exact driver shape (shell wrapper + `timeout -k`) at a deliberately
+    short internal budget."""
+    env = _bench_env()
+    env.update(
+        JAX_PLATFORMS="cpu",
+        PHANT_NO_COMPILE_CACHE="0",
+        PHANT_JAX_CACHE=str(tmp_path / "jax_cache"),
+        PHANT_BENCH_WARM="8",
+        PHANT_BENCH_BLOCKS="16",
+        PHANT_BENCH_TRIE="1024",
+        PHANT_BENCH_KECCAK_N="2048",
+        PHANT_BENCH_ONLY="engine,keccak",
+        # internal budget far below the external window, and below the
+        # reserve (60s) so every section must take the skip path
+        PHANT_BENCH_GLOBAL_TIMEOUT="45",
+        PHANT_BENCH_PROBE_RETRIES="0",
+    )
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    out = subprocess.run(
+        ["timeout", "-k", "5", "120", "sh", "-c", f"{sys.executable} bench.py"],
+        capture_output=True,
+        text=True,
+        timeout=180,
+        env=env,
+        cwd=repo,
+    )
+    # rc 0: bench finished ITSELF — the external timeout (which r05 proved
+    # can strand the artifact) never fired
+    assert out.returncode == 0, (out.returncode, out.stderr[-2000:])
+    json_lines = [ln for ln in out.stdout.splitlines() if ln.startswith("{")]
+    assert len(json_lines) == 1, out.stdout[-2000:]
+    rec = json.loads(json_lines[0])
+    assert rec["metric"] == "block_witness_verifications_per_sec"
+    skipped = rec["detail"].get("skipped_budget")
+    assert skipped and "engine" in skipped, rec["detail"]
+
+
+@pytest.mark.slow
 def test_bench_global_deadline_always_prints_json(tmp_path):
     """A hung tunnel must still yield the driver a JSON line: force the
     global deadline to fire almost immediately and check the fallback."""

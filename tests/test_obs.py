@@ -1,0 +1,389 @@
+"""Request-scoped tracing + flight recorder (phant_tpu/obs/, PR 4).
+
+Covers the acceptance surface: trace ids never cross-contaminate between
+concurrent threads (span stacks stay per-thread, ids stay per-context),
+the scheduler attaches a joinable batch record to every coalesced request,
+the flight ring respects its bound and stays consistent under concurrent
+writers, crash dumps are valid JSON containing the crashing batch's trace,
+`GET /debug/flight` serves the same records live, the /healthz 503 flip
+dumps once, and the watchdog flags a stalled executor exactly once per
+batch.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+import urllib.error
+import urllib.request
+from concurrent.futures import ThreadPoolExecutor
+
+import numpy as np
+import pytest
+
+from phant_tpu import rlp
+from phant_tpu.crypto.keccak import keccak256
+from phant_tpu.engine_api.server import EngineAPIServer
+from phant_tpu.mpt.mpt import Trie
+from phant_tpu.mpt.proof import generate_proof
+from phant_tpu.obs import FlightRecorder, flight
+from phant_tpu.ops.witness_engine import WitnessEngine
+from phant_tpu.serving import (
+    SchedulerConfig,
+    SchedulerDown,
+    VerificationScheduler,
+)
+from phant_tpu.utils.trace import (
+    current_trace_id,
+    metrics,
+    new_trace_id,
+    span,
+    trace_context,
+)
+
+
+def _witness_set(n_witnesses: int, trie_size: int = 128, picks: int = 8, seed: int = 5):
+    rng = np.random.default_rng(seed)
+    trie = Trie()
+    keys = []
+    for _ in range(trie_size):
+        k = keccak256(rng.bytes(20))
+        trie.put(k, rlp.encode([rlp.encode_uint(1), rng.bytes(8)]))
+        keys.append(k)
+    root = trie.root_hash()
+    out = []
+    for _ in range(n_witnesses):
+        idx = rng.choice(len(keys), size=picks, replace=False)
+        nodes: dict = {}
+        for i in idx:
+            for enc in generate_proof(trie, keys[int(i)]):
+                nodes[enc] = None
+        out.append((root, list(nodes)))
+    return out
+
+
+class _BoomEngine:
+    def verify_batch(self, witnesses):
+        raise RuntimeError("engine exploded")
+
+
+# ---------------------------------------------------------------------------
+# trace context: per-thread identity, no cross-contamination
+# ---------------------------------------------------------------------------
+
+
+def test_trace_context_nesting_and_isolation():
+    assert current_trace_id() is None
+    with trace_context("aa" * 8) as outer:
+        assert current_trace_id() == outer == "aa" * 8
+        with trace_context() as inner:
+            assert current_trace_id() == inner != outer
+        assert current_trace_id() == outer
+    assert current_trace_id() is None
+
+
+def test_interleaved_threads_never_cross_contaminate():
+    """The concurrency acceptance criterion: N threads interleaving spans
+    inside their own trace contexts — every span record must carry ITS
+    thread's trace id, and phases must never leak across threads."""
+    n = 8
+    rounds = 25
+    records: list = []
+    rec_lock = threading.Lock()
+
+    def sink(rec):
+        with rec_lock:
+            records.append(rec)
+
+    from phant_tpu.utils.trace import add_span_sink, remove_span_sink
+
+    add_span_sink(sink)
+    barrier = threading.Barrier(n)
+
+    def worker(i: int) -> list:
+        tids = []
+        barrier.wait()
+        for r in range(rounds):
+            with trace_context() as tid:
+                tids.append(tid)
+                with span("verify_block", worker=i, round=r):
+                    with metrics.phase("stateless.execute"):
+                        time.sleep(0.0002)
+        return tids
+
+    try:
+        with ThreadPoolExecutor(max_workers=n) as pool:
+            per_thread = list(pool.map(worker, range(n)))
+    finally:
+        remove_span_sink(sink)
+
+    assert len(records) >= n * rounds
+    by_tid = {}
+    for rec in records:
+        if "worker" in rec:
+            by_tid[rec["trace_id"]] = rec["worker"]
+    for i, tids in enumerate(per_thread):
+        assert len(set(tids)) == rounds  # fresh id per request
+        for tid in tids:
+            assert by_tid[tid] == i  # the span carried ITS thread's id
+    # per-thread span stacks: every record closed cleanly with its phases
+    own = [r for r in records if "worker" in r]
+    for rec in own:
+        assert rec["span"] == "verify_block"
+        assert rec["phases"]["stateless.execute"]["count"] == 1
+
+
+def test_scheduler_coalesced_requests_each_get_own_trace_with_shared_batch():
+    """Concurrent submits through one scheduler: every request's meta must
+    carry ITS OWN trace id context and the SHARED batch_id of the engine
+    dispatch that served it."""
+    wits = _witness_set(16)
+    s = VerificationScheduler(
+        engine=WitnessEngine(),
+        config=SchedulerConfig(max_batch=32, max_wait_ms=150.0, queue_depth=256),
+    )
+    results = {}
+    res_lock = threading.Lock()
+    barrier = threading.Barrier(len(wits))
+
+    def go(i):
+        barrier.wait()
+        with trace_context() as tid:
+            ok, meta = s.verify_traced(*wits[i])
+        with res_lock:
+            results[i] = (tid, ok, meta)
+
+    try:
+        threads = [threading.Thread(target=go, args=(i,)) for i in range(len(wits))]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+    finally:
+        s.shutdown()
+
+    assert all(ok for _tid, ok, _m in results.values())
+    batch_ids = {m["batch_id"] for _t, _o, m in results.values()}
+    sizes = {m["batch_size"] for _t, _o, m in results.values()}
+    assert max(sizes) > 1  # coalescing actually happened
+    assert len(batch_ids) < len(wits)  # requests shared batches
+    for _i, (tid, _ok, meta) in results.items():
+        assert meta["bucket_bytes"] > 0
+        assert meta["queue_wait_ms"] >= 0
+        assert meta["backend"] in ("native", "cached", "device")
+    # the flight ring joins each trace id to its batch
+    done = [r for r in flight.records() if r["kind"] == "sched.batch_done"]
+    ring_tids = {t for r in done for t in r["trace_ids"] if t}
+    assert {tid for tid, _o, _m in results.values()} <= ring_tids
+
+
+# ---------------------------------------------------------------------------
+# flight recorder: bound + consistency under concurrent writers
+# ---------------------------------------------------------------------------
+
+
+def test_ring_respects_bound_and_stays_consistent_under_writers():
+    fr = FlightRecorder(capacity=256)
+    n_threads, per_thread = 8, 400  # 3200 records through a 256 ring
+
+    def writer(i):
+        for k in range(per_thread):
+            fr.record("sched.admit", writer=i, k=k)
+
+    with ThreadPoolExecutor(max_workers=n_threads) as pool:
+        list(pool.map(writer, range(n_threads)))
+    recs = fr.records()
+    assert len(recs) == 256  # exactly the bound
+    # every surviving record is whole and seqs are strictly increasing
+    seqs = [r["seq"] for r in recs]
+    assert seqs == sorted(seqs) and len(set(seqs)) == len(seqs)
+    assert seqs[-1] == n_threads * per_thread
+    for r in recs:
+        assert r["kind"] == "sched.admit" and "writer" in r and "t" in r
+    assert len(fr) == 256
+    fr.clear()
+    assert fr.records() == []
+
+
+def test_dump_writes_valid_json_and_prunes(tmp_path, monkeypatch):
+    monkeypatch.setenv("PHANT_FLIGHT_KEEP", "3")
+    fr = FlightRecorder(capacity=8)
+    fr.record("error", error="x")
+    paths = []
+    for i in range(5):
+        p = fr.dump(f"sigterm", dirpath=str(tmp_path))
+        assert p is not None
+        paths.append(p)
+        time.sleep(0.01)
+        os.utime(p)  # distinct mtimes irrelevant — pruning is name-sorted
+    kept = sorted(os.listdir(tmp_path))
+    assert len(kept) == 3, kept
+    d = json.load(open(os.path.join(tmp_path, kept[-1])))
+    assert d["reason"] == "sigterm"
+    assert any(r["kind"] == "error" for r in d["records"])
+
+
+# ---------------------------------------------------------------------------
+# crash postmortem + /debug/flight over HTTP
+# ---------------------------------------------------------------------------
+
+
+def _get_json(base, path):
+    with urllib.request.urlopen(base + path, timeout=10) as resp:
+        return resp.status, json.loads(resp.read())
+
+
+def test_crash_dump_contains_crashing_batch_trace(tmp_path, monkeypatch):
+    """An induced executor crash writes a valid-JSON dump whose records
+    include the crashing batch's start event and trace ids — and
+    /debug/flight served the same records pre-crash."""
+    monkeypatch.setenv("PHANT_FLIGHT_DIR", str(tmp_path))
+    wits = _witness_set(2)
+    s = VerificationScheduler(
+        engine=_BoomEngine(), config=SchedulerConfig(max_wait_ms=1.0)
+    )
+    try:
+        with trace_context("cc" * 8):
+            fut = s.submit_witness(*wits[0])
+        with pytest.raises(SchedulerDown):
+            fut.result(timeout=30)
+    finally:
+        s.shutdown()
+    dumps = [f for f in os.listdir(tmp_path) if "executor_crash" in f]
+    assert len(dumps) == 1, os.listdir(tmp_path)
+    d = json.load(open(os.path.join(tmp_path, dumps[0])))
+    crash = [r for r in d["records"] if r["kind"] == "sched.executor_crash"]
+    assert crash and "engine exploded" in crash[0]["error"]
+    assert crash[0]["crashed_trace_ids"] == ["cc" * 8]
+    starts = [r for r in d["records"] if r["kind"] == "sched.batch_start"]
+    assert starts and starts[-1]["trace_ids"] == ["cc" * 8]
+    assert starts[-1]["batch_id"] == crash[0]["batch_id"]
+
+
+def test_debug_flight_endpoint_and_healthz_flip_dump(tmp_path, monkeypatch):
+    monkeypatch.setenv("PHANT_FLIGHT_DIR", str(tmp_path))
+    from phant_tpu.blockchain.chain import Blockchain
+    from phant_tpu.config import ChainId
+    from phant_tpu.state.statedb import StateDB
+    from phant_tpu.__main__ import make_genesis_parent_header
+
+    chain = Blockchain(
+        chain_id=int(ChainId.Testing),
+        state=StateDB(),
+        parent_header=make_genesis_parent_header(),
+        verify_state_root=False,
+    )
+    server = EngineAPIServer(chain, host="127.0.0.1", port=0)
+    server.serve_in_background()
+    try:
+        base = f"http://127.0.0.1:{server.port}"
+        status, body = _get_json(base, "/debug/flight")
+        assert status == 200
+        assert body["capacity"] == flight.capacity
+        assert isinstance(body["records"], list)
+
+        # crash the executor; the ring the endpoint served becomes the dump
+        server.scheduler._engine = _BoomEngine()
+        with pytest.raises(SchedulerDown):
+            server.scheduler.submit_witness(*_witness_set(1)[0]).result(30)
+        assert any("executor_crash" in f for f in os.listdir(tmp_path))
+
+        # first 503 scrape dumps once; the second must not dump again
+        for _ in range(2):
+            with pytest.raises(urllib.error.HTTPError) as exc_info:
+                urllib.request.urlopen(base + "/healthz", timeout=10)
+            assert exc_info.value.code == 503
+        healthz_dumps = [f for f in os.listdir(tmp_path) if "healthz_503" in f]
+        assert len(healthz_dumps) == 1, os.listdir(tmp_path)
+    finally:
+        server.shutdown()
+
+
+def test_http_response_carries_trace_header():
+    from phant_tpu.blockchain.chain import Blockchain
+    from phant_tpu.config import ChainId
+    from phant_tpu.state.statedb import StateDB
+    from phant_tpu.__main__ import make_genesis_parent_header
+
+    chain = Blockchain(
+        chain_id=int(ChainId.Testing),
+        state=StateDB(),
+        parent_header=make_genesis_parent_header(),
+        verify_state_root=False,
+    )
+    server = EngineAPIServer(chain, host="127.0.0.1", port=0)
+    server.serve_in_background()
+    try:
+        base = f"http://127.0.0.1:{server.port}"
+        req = urllib.request.Request(
+            base + "/",
+            data=json.dumps(
+                {"jsonrpc": "2.0", "id": 1, "method": "engine_getClientVersionV1"}
+            ).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        tids = set()
+        for _ in range(3):
+            with urllib.request.urlopen(req, timeout=10) as resp:
+                tid = resp.headers.get("X-Phant-Trace")
+                assert tid and len(tid) == 16
+                tids.add(tid)
+        assert len(tids) == 3  # a fresh identity per request
+    finally:
+        server.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# watchdog
+# ---------------------------------------------------------------------------
+
+
+def test_watchdog_flags_stalled_executor_once():
+    """The stall bound is a full execution allowance (config.deadline_ms)
+    from PICKUP — a job's admission deadline must not flag a healthy
+    executor that merely picked the job up late (deadline_s=30 here)."""
+    metrics.reset()
+    flight.clear()
+    s = VerificationScheduler(
+        engine=object(), config=SchedulerConfig(deadline_ms=200.0)
+    )
+    gate = threading.Event()
+    try:
+        with trace_context("dd" * 8):
+            fut = s.submit_serial(gate.wait, deadline_s=30.0)
+        time.sleep(1.0)  # allowance 0.2s + >= one watchdog poll (0.25s)
+        stalls = [r for r in flight.records() if r["kind"] == "sched.stall"]
+        assert len(stalls) == 1, stalls  # once per batch, not per poll
+        assert stalls[0]["lane"] == "serial"
+        assert stalls[0]["trace_ids"] == ["dd" * 8]
+        assert stalls[0]["overdue_ms"] > 0
+        assert metrics.snapshot()["counters"]["sched.watchdog_stalls"] == 1
+    finally:
+        gate.set()
+        fut.result(10)
+        s.shutdown()
+
+
+def test_watchdog_quiet_on_healthy_executor():
+    metrics.reset()
+    flight.clear()
+    wits = _witness_set(4)
+    s = VerificationScheduler(
+        engine=WitnessEngine(),
+        config=SchedulerConfig(max_wait_ms=1.0, deadline_ms=30_000.0),
+    )
+    try:
+        assert all(s.submit_witness(*w).result(30) for w in wits)
+        time.sleep(0.6)
+        assert not [r for r in flight.records() if r["kind"] == "sched.stall"]
+        assert "sched.watchdog_stalls" not in metrics.snapshot()["counters"]
+    finally:
+        s.shutdown()
+
+
+def test_new_trace_id_shape():
+    ids = {new_trace_id() for _ in range(64)}
+    assert len(ids) == 64
+    assert all(len(t) == 16 and int(t, 16) >= 0 for t in ids)

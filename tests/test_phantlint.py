@@ -468,6 +468,100 @@ def test_metricname_rule(tmp_path, monkeypatch):
 
 
 # ---------------------------------------------------------------------------
+# SPANNAME
+# ---------------------------------------------------------------------------
+
+SPAN_TRACEY_SRC = '''
+import contextlib
+
+SPAN_HELP = {
+    "good.span": "a fine span",
+    "good.event": "a fine flight-event kind",
+    "dead.span": "never emitted anywhere",
+}
+
+@contextlib.contextmanager
+def span(name, **attrs):
+    yield None
+'''
+
+SPAN_FLIGHT_SRC = '''
+class FlightRecorder:
+    def record(self, kind, **fields):
+        self.record(kind)  # internal pass-through: not a registry site
+
+flight = FlightRecorder()
+'''
+
+SPAN_APP_SRC = '''
+from pkg.tracey import span
+from pkg.flight import flight
+
+def go(n):
+    with span("good.span", block=n):
+        pass
+    with span("missing.span"):
+        pass
+    with span("Bad-Span"):
+        pass
+    with span(n):
+        pass
+    flight.record("good.event", detail=1)
+    flight.record("missing.event")
+    flight.record(kind=n)
+'''
+
+
+def test_spanname_rule(tmp_path, monkeypatch):
+    """SPANNAME holds span()/flight.record() names to the METRICNAME
+    discipline against SPAN_HELP: literal, [a-z0-9_.]+, cataloged, no
+    dead entries."""
+    from phant_tpu.analysis.rules.spanname import SpanNameRule
+
+    res = run_fixture(
+        tmp_path,
+        monkeypatch,
+        {
+            "tracey.py": SPAN_TRACEY_SRC,
+            "flight.py": SPAN_FLIGHT_SRC,
+            "app.py": SPAN_APP_SRC,
+        },
+        [SpanNameRule()],
+    )
+    msgs = [f.message for f in res.new]
+    assert any("'missing.span' has no SPAN_HELP" in m for m in msgs), msgs
+    assert any("'missing.event' has no SPAN_HELP" in m for m in msgs), msgs
+    assert any("'Bad-Span' is not [a-z0-9_.]+" in m for m in msgs), msgs
+    # the dynamic span name AND the keyword-passed dynamic kind are S1
+    assert sum("non-literal span/event name" in m for m in msgs) == 2, msgs
+    assert any("'dead.span' is never emitted" in m for m in msgs), msgs
+    # cataloged names and the recorder's internal pass-through stay quiet
+    assert not any("'good.span'" in m or "'good.event'" in m for m in msgs), msgs
+
+
+def test_spanname_mutation_uncataloged_span_fails_cli(tmp_path, monkeypatch):
+    """Acceptance-style mutation: renaming a cataloged span at its emit
+    site makes the SPANNAME gate red twice over (uncataloged emit + dead
+    catalog entry) — the trace vocabulary cannot silently fork."""
+    from phant_tpu.analysis.rules.spanname import SpanNameRule
+
+    mutated = SPAN_APP_SRC.replace('span("good.span", block=n)', 'span("good.spam", block=n)')
+    res = run_fixture(
+        tmp_path,
+        monkeypatch,
+        {
+            "tracey.py": SPAN_TRACEY_SRC,
+            "flight.py": SPAN_FLIGHT_SRC,
+            "app.py": mutated,
+        },
+        [SpanNameRule()],
+    )
+    msgs = [f.message for f in res.new]
+    assert any("'good.spam' has no SPAN_HELP" in m for m in msgs), msgs
+    assert any("'good.span' is never emitted" in m for m in msgs), msgs
+
+
+# ---------------------------------------------------------------------------
 # baseline round trip
 # ---------------------------------------------------------------------------
 
