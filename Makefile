@@ -57,7 +57,8 @@ soak:
 # Regression sentinel over the committed BENCH_r*/MULTICHIP_r* artifacts:
 # aligns every section metric across rounds and flags a latest-round value
 # outside the noise-aware bar (or a round that produced no artifact at
-# all). check.sh runs it --report-only; strict mode exits 1 on a flag.
+# all — unless acknowledged in BENCH_ACK, the root-caused-and-fixed list).
+# check.sh runs the SAME strict mode as a real gate; exits 1 on a flag.
 trend:
 	python scripts/benchtrend.py
 

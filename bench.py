@@ -51,7 +51,16 @@ root. Verifiers measured on the SAME span:
   * sched_verify_many (detail) — the same span through the continuous-
     batching scheduler's offline verify_many (phant_tpu/serving/): the
     IDENTICAL admission/assembly/executor code the Engine API serves
-    with, plus the mean assembled batch size.
+    with, plus the mean assembled batch size; sched_depth1/sched_depth2
+    are the native-route pipeline-depth parity pair (the CPU path is
+    intern-table bound, so depth 2 must track depth 1).
+  * engine_pipeline (device section) — the PR 5 tentpole's A/B: the
+    device-routed engine through the scheduler at pipeline depth 1 vs 2
+    (pack of batch N+1 overlapping device compute + digest resolve of
+    batch N), paired interleaved runs; `pipeline_overlap_pct` is the
+    median paired speedup and `pipeline_noise_aa_pct` the A/A (d1 vs d1)
+    noise bar measured the same way. XLA-CPU is the device proxy on
+    CPU-only runs.
 
 The cold fused device kernel (everything incl. RLP ref parsing on device,
 ops/witness_jax.py witness_verify_fused) is timed honestly per batch, and
@@ -844,10 +853,37 @@ def sec_engine_cpu() -> dict:
         sched_s = time.perf_counter() - t0
         sched_stats = sched.stats_snapshot()
 
+    # pipeline-depth parity on the native route (no jax): the CPU path is
+    # intern-table bound (scan/commit serialize on the engine lock), so
+    # depth 2 must track depth 1 within noise — the overlap WIN is
+    # measured on the device-routed engine_pipeline section, where the
+    # novel-node compute actually leaves the host. Interleaved best-of.
+    def _sched_span(depth: int) -> float:
+        eng_p = WitnessEngine()
+        for i in range(0, len(warm), b):
+            assert eng_p.verify_batch(warm[i : i + b]).all()
+        with VerificationScheduler(
+            engine=eng_p,
+            config=SchedulerConfig(
+                max_batch=b, max_wait_ms=50.0, queue_depth=4096,
+                pipeline_depth=depth,
+            ),
+        ) as sp:
+            t0 = time.perf_counter()
+            assert sp.verify_many(span).all()
+            return time.perf_counter() - t0
+
+    pd1 = pd2 = float("inf")
+    for _ in range(2):
+        pd1 = min(pd1, _sched_span(1))
+        pd2 = min(pd2, _sched_span(2))
+
     return {
         "sched_verify_many_blocks_per_sec": round(n_blocks / sched_s, 2),
         "sched_mean_batch": sched_stats["mean_batch"],
         "sched_batches": sched_stats["batches"],
+        "sched_depth1_blocks_per_sec": round(n_blocks / pd1, 2),
+        "sched_depth2_blocks_per_sec": round(n_blocks / pd2, 2),
         "cpu_baseline_blocks_per_sec": round(cpu_rate, 2),
         "cpu_baseline_fastkeccak_blocks_per_sec": round(n_blocks / fastk_s, 2),
         "engine_cpu_blocks_per_sec": round(n_blocks / ecpu_s, 2),
@@ -1518,6 +1554,111 @@ def sec_replay_cpu() -> dict:
     return _replay_variants("cpu")
 
 
+def sec_engine_pipeline() -> dict:
+    """Pipelined witness execution A/B (the PR 5 tentpole): the same span
+    through the serving scheduler at pipeline depth 1 (serialized pack ->
+    dispatch -> resolve, the pre-pipeline behavior) vs depth 2 (pack of
+    batch N+1 overlaps device compute + digest resolve of batch N), on
+    the DEVICE-routed engine (device_batch_floor=0, so every novel batch
+    ships to the accelerator).
+
+    On a CPU-only run the XLA-CPU backend is the device proxy
+    (PHANT_ALLOW_JAX_CPU=1). Honesty note, measured on the 2-core dev
+    box: the proxy's "device" compute runs on the same host cores the
+    pack stage needs, so the demonstrable overlap is bounded by the
+    host-side fraction of a batch (~+10% median there); on a real
+    accelerator the compute is off-host and the full pack/compute overlap
+    applies. The box also swings single runs ±30%, so the headline
+    overlap number is the MEDIAN of PAIRED interleaved runs (robust to
+    load drift), published next to the measured A/A noise bar
+    (`pipeline_noise_aa_pct`, the same median statistic over depth-1 vs
+    depth-1 pairs) — the win claim is `pipeline_overlap_pct >
+    pipeline_noise_aa_pct`, never a raw delta against box noise.
+    Verdicts are asserted byte-identical to direct verify_batch once per
+    section (the compile-warm run)."""
+    import jax
+
+    from phant_tpu.backend import set_crypto_backend
+    from phant_tpu.ops.witness_engine import WitnessEngine
+    from phant_tpu.serving.scheduler import (
+        SchedulerConfig,
+        VerificationScheduler,
+    )
+
+    warm, span = _witness_chain()
+    n_blocks = len(span)
+    out: dict = {"backend": jax.devices()[0].platform}
+    if jax.default_backend() == "cpu":
+        os.environ["PHANT_ALLOW_JAX_CPU"] = "1"
+        out["pipeline_proxy"] = "xla-cpu"
+    mb = int(os.environ.get("PHANT_BENCH_PIPELINE_BATCH", "16"))
+    pairs = int(os.environ.get("PHANT_BENCH_PIPELINE_PAIRS", "5"))
+    wb = int(os.environ.get("PHANT_BENCH_ENGINE_BATCH", "256"))
+
+    set_crypto_backend("cpu")
+    oracle = WitnessEngine()
+    for i in range(0, len(warm), wb):
+        assert oracle.verify_batch(warm[i : i + wb]).all()
+    want = oracle.verify_batch(span)
+
+    def one(depth: int, check: bool = False) -> float:
+        set_crypto_backend("cpu")  # warm the cache on the fast native route
+        eng = WitnessEngine(device_batch_floor=0)
+        for i in range(0, len(warm), wb):
+            assert eng.verify_batch(warm[i : i + wb]).all()
+        set_crypto_backend("tpu")  # timed span: device-routed
+        try:
+            with VerificationScheduler(
+                engine=eng,
+                config=SchedulerConfig(
+                    max_batch=mb, max_wait_ms=100.0,
+                    queue_depth=n_blocks + 1, pipeline_depth=depth,
+                ),
+            ) as s:
+                t0 = time.perf_counter()
+                got = s.verify_many(span)
+                dt = time.perf_counter() - t0
+            if check:
+                assert (got == np.asarray(want)).all(), (
+                    "pipelined verdicts diverge from direct verify_batch"
+                )
+            else:
+                assert got.all()
+            return dt
+        finally:
+            set_crypto_backend("cpu")
+
+    one(2, check=True)  # compile warm + byte-identity check, discarded
+    d1: list = []
+    d2: list = []
+    overlaps: list = []
+    aa: list = []
+    for _ in range(pairs):
+        a = one(1)
+        b2 = one(2)
+        a2 = one(1)  # the A/A twin measures the box, not the code
+        d1 += [a, a2]
+        d2.append(b2)
+        overlaps.append(1.0 - b2 / a)
+        aa.append(abs(1.0 - a2 / a))
+    overlaps.sort()
+    aa.sort()
+    out.update(
+        {
+            "engine_pipeline_d1_blocks_per_sec": round(n_blocks / min(d1), 2),
+            "engine_pipeline_d2_blocks_per_sec": round(n_blocks / min(d2), 2),
+            "pipeline_overlap_pct": round(
+                overlaps[len(overlaps) // 2] * 100, 1
+            ),
+            "pipeline_noise_aa_pct": round(aa[len(aa) // 2] * 100, 1),
+            "pipeline_batch": mb,
+            "pipeline_pairs": pairs,
+        }
+    )
+    _bank(out)
+    return out
+
+
 def sec_replay_device() -> dict:
     return _replay_variants("tpu")
 
@@ -1533,9 +1674,11 @@ _CPU_SECTIONS = {
 }
 _DEVICE_SECTIONS = {
     # priority order under the global budget: the headline (engine) first,
-    # then keccak (cheap, and r5's device-kernel story rides on its
-    # slope-timed resident rates), then the long ecrecover/replay runs
+    # then the pipelined A/B (the PR 5 overlap claim), then keccak (cheap,
+    # and r5's device-kernel story rides on its slope-timed resident
+    # rates), then the long ecrecover/replay runs
     "engine": sec_engine_device,
+    "engine_pipeline": sec_engine_pipeline,
     "keccak": sec_keccak_device,
     "ecrecover": sec_ecrecover_device,
     "replay": sec_replay_device,
@@ -1544,6 +1687,7 @@ _DEVICE_SECTIONS = {
 # per-section child budgets (seconds); cold device compiles dominate
 _DEVICE_BUDGET = {
     "engine": 700,
+    "engine_pipeline": 420,
     "ecrecover": 900,
     "replay": 700,
     "state_root": 480,
@@ -1680,8 +1824,8 @@ def main() -> None:
     detail = _PARTIAL["detail"]
 
     only = os.environ.get("PHANT_BENCH_ONLY", "")
-    selected = [s.strip() for s in only.split(",") if s.strip()] or list(
-        _CPU_SECTIONS
+    selected = [s.strip() for s in only.split(",") if s.strip()] or (
+        list(_CPU_SECTIONS) + ["engine_pipeline"]
     )
     # legacy per-section kill switches stay honored
     for flag, sec in (
@@ -1826,7 +1970,11 @@ def main() -> None:
         of XLA-CPU compile for a non-number (r3 lesson)."""
         os.environ["PHANT_BENCH_DEVICE"] = "0"
         _pin_jax_cpu()
-        for name in ("replay", "keccak"):
+        # engine_pipeline runs inline on CPU-only boxes (XLA-CPU device
+        # proxy): the depth A/B is the PR 5 acceptance number, and its
+        # witness-shape compiles are seconds, not the minutes that keep
+        # engine/state_root device variants out of the inline list
+        for name in ("engine_pipeline", "replay", "keccak"):
             if name not in selected:
                 continue
             if name == "keccak" and os.environ.get("PHANT_BENCH_KECCAK", "1") in ("0", ""):

@@ -6,7 +6,9 @@
 // feeds the core scattered PyBytes pointers, so the Python side of
 // verify_batch is two calls and zero copies.
 //
-// Protocol (mirrors ops/witness_engine.WitnessEngine._verify_native):
+// Two protocols share the walk/commit machinery:
+//
+// Classic (one batch at a time, mirrors WitnessEngine._verify_native):
 //   scan(witnesses)  -> (novel: list[bytes], miss: int, total: int)
 //                       witnesses = sequence of (root32, sequence[bytes]);
 //                       batch state (node ptrs, rows, block bounds, roots)
@@ -20,8 +22,22 @@
 //   flush()          -> drop the interned generation (eviction).
 //   nodes/digests()  -> interned counts (eviction policy + stats RPC).
 //
-// Everything runs under the GIL — the engine is driven under
-// WitnessEngine's lock anyway, and each call is microseconds-scale.
+// Pipelined (WitnessEngine.begin_batch/resolve_batch, PR 5): batch state
+// lives in a standalone Batch object so several scanned batches can be
+// outstanding at once — batch N+1 scans (executor thread, pack stage)
+// while batch N hashes/commits (resolve worker). A node novel in two
+// outstanding batches is interned twice (a benign duplicate row: both
+// rows carry the same digest refid, so verdicts are unaffected); flushes
+// are the caller's responsibility to order around outstanding batches
+// (WitnessEngine defers eviction while handles are in flight).
+//   scan_begin(witnesses)        -> (Batch, novel, miss, total)
+//   finish_batch(Batch, digests) -> verdict bytes
+//   finish_batch_native(Batch)   -> verdict bytes (in-C keccak)
+//
+// The pure-C stages (scan loop, commit, verdict, in-C hashing) release
+// the GIL: the whole point of the pipelined protocol is that the resolve
+// worker's C time runs concurrently with the executor's Python time.
+// Engine-level exclusion of table mutation is WitnessEngine._lock.
 
 #define PY_SSIZE_T_CLEAN
 #include <Python.h>
@@ -45,36 +61,46 @@ int64_t phant_engine_commit_hash_ptrs(void*, const uint8_t* const*,
                                       const uint32_t*, uint64_t);
 int phant_engine_verdict(void*, const int64_t*, const uint64_t*, uint64_t,
                          const uint8_t*, uint8_t*);
+void phant_keccak256_ptrs_fast(const uint8_t* const*, const uint32_t*,
+                               uint64_t, uint8_t*);
 }
 
 namespace {
 
+// One scanned batch: node pointers (pinned via `keep`), scan rows, block
+// bounds, roots. Owned inline by the engine (classic protocol) or by a
+// Batch object (pipelined protocol).
+struct BatchState {
+  std::vector<PyObject*> node_objs;  // borrowed (owned via `keep`)
+  std::vector<const uint8_t*> ptrs;
+  std::vector<uint32_t> lens;
+  std::vector<int64_t> rows;
+  std::vector<uint32_t> novel_idx;
+  std::vector<uint64_t> block_offs;
+  std::vector<uint8_t> roots;
+  std::vector<uint8_t> digests;  // 32B/novel, filled by hash_batch()
+  uint64_t n_novel = 0;
+  PyObject* keep = nullptr;  // the witnesses object (pins node bytes)
+};
+
+void batch_clear(BatchState* bs) {
+  bs->n_novel = 0;
+  Py_CLEAR(bs->keep);
+}
+
 struct EngineObject {
   PyObject_HEAD
   void* eng;
-  // batch state, valid between scan() and finish()
-  std::vector<PyObject*>* node_objs;  // borrowed (owned via `keep`)
-  std::vector<const uint8_t*>* ptrs;
-  std::vector<uint32_t>* lens;
-  std::vector<int64_t>* rows;
-  std::vector<uint32_t>* novel_idx;
-  std::vector<uint64_t>* block_offs;
-  std::vector<uint8_t>* roots;
-  uint64_t n_novel;
+  BatchState* batch;  // classic-protocol slot, valid between scan/finish
   int have_batch;
-  PyObject* keep;  // the witnesses object (pins every node's bytes)
 };
 
 void Engine_dealloc(EngineObject* self) {
   if (self->eng) phant_engine_free(self->eng);
-  delete self->node_objs;
-  delete self->ptrs;
-  delete self->lens;
-  delete self->rows;
-  delete self->novel_idx;
-  delete self->block_offs;
-  delete self->roots;
-  Py_CLEAR(self->keep);
+  if (self->batch) {
+    batch_clear(self->batch);
+    delete self->batch;
+  }
   Py_TYPE(self)->tp_free(reinterpret_cast<PyObject*>(self));
 }
 
@@ -83,28 +109,21 @@ PyObject* Engine_new(PyTypeObject* type, PyObject*, PyObject*) {
       reinterpret_cast<EngineObject*>(type->tp_alloc(type, 0));
   if (!self) return nullptr;
   self->eng = phant_engine_new();
-  self->node_objs = new std::vector<PyObject*>();
-  self->ptrs = new std::vector<const uint8_t*>();
-  self->lens = new std::vector<uint32_t>();
-  self->rows = new std::vector<int64_t>();
-  self->novel_idx = new std::vector<uint32_t>();
-  self->block_offs = new std::vector<uint64_t>();
-  self->roots = new std::vector<uint8_t>();
-  self->n_novel = 0;
+  self->batch = new BatchState();
   self->have_batch = 0;
-  self->keep = nullptr;
   return reinterpret_cast<PyObject*>(self);
 }
 
 void clear_batch(EngineObject* self) {
   self->have_batch = 0;
-  self->n_novel = 0;
-  Py_CLEAR(self->keep);
+  batch_clear(self->batch);
 }
 
-// scan(witnesses) -> (novel list, miss, total)
-PyObject* Engine_scan(EngineObject* self, PyObject* witnesses) {
-  clear_batch(self);
+// Walk `witnesses` into `bs` (ptrs/lens/block_offs/roots + keep), run the
+// C hit-scan, and build the novel list. Returns the (novel, miss, total)
+// tuple, or nullptr with an exception set (bs left cleared).
+PyObject* scan_into(EngineObject* self, PyObject* witnesses, BatchState* bs) {
+  batch_clear(bs);
   // `keep` pins every container whose items back a stored pointer: the
   // materialized outer sequence plus each block's materialized node
   // sequence (PySequence_Fast returns the list/tuple itself, or a fresh
@@ -119,11 +138,11 @@ PyObject* Engine_scan(EngineObject* self, PyObject* witnesses) {
   }
   Py_DECREF(wseq);  // owned by `keep` now
   const Py_ssize_t n_blocks = PySequence_Fast_GET_SIZE(wseq);
-  auto& ptrs = *self->ptrs;
-  auto& node_objs = *self->node_objs;
-  auto& lens = *self->lens;
-  auto& boffs = *self->block_offs;
-  auto& roots = *self->roots;
+  auto& ptrs = bs->ptrs;
+  auto& node_objs = bs->node_objs;
+  auto& lens = bs->lens;
+  auto& boffs = bs->block_offs;
+  auto& roots = bs->roots;
   ptrs.clear();
   node_objs.clear();
   lens.clear();
@@ -184,27 +203,29 @@ PyObject* Engine_scan(EngineObject* self, PyObject* witnesses) {
     }
     boffs.push_back(ptrs.size());
   }
-  // roots vector backs the verdict call; node ptrs live until finish()
-  self->keep = keep;
+  // roots vector backs the verdict call; node ptrs live until finish
+  bs->keep = keep;
 
   const uint64_t n = ptrs.size();
-  self->rows->resize(n);
-  self->novel_idx->resize(n ? n : 1);
+  bs->rows.resize(n);
+  bs->novel_idx.resize(n ? n : 1);
   uint64_t counts[2] = {0, 0};
+  // pure C from here: the scan loop touches only the pinned buffers
+  Py_BEGIN_ALLOW_THREADS
   phant_engine_scan_ptrs(self->eng, ptrs.data(), lens.data(), n,
-                         self->rows->data(), self->novel_idx->data(), counts);
-  self->n_novel = counts[1];
-  self->have_batch = 1;
+                         bs->rows.data(), bs->novel_idx.data(), counts);
+  Py_END_ALLOW_THREADS
+  bs->n_novel = counts[1];
 
   // the novel list shares the existing bytes objects (no copies) — they
   // are alive via `keep` and the INCREF here
   PyObject* novel = PyList_New(static_cast<Py_ssize_t>(counts[1]));
   if (!novel) {
-    clear_batch(self);  // don't leave a half-built batch retained on OOM
+    batch_clear(bs);  // don't leave a half-built batch retained on OOM
     return nullptr;
   }
   for (uint64_t k = 0; k < counts[1]; ++k) {
-    PyObject* nb = node_objs[(*self->novel_idx)[k]];
+    PyObject* nb = node_objs[bs->novel_idx[k]];
     Py_INCREF(nb);
     PyList_SET_ITEM(novel, static_cast<Py_ssize_t>(k), nb);
   }
@@ -214,23 +235,76 @@ PyObject* Engine_scan(EngineObject* self, PyObject* witnesses) {
     // "N" args are consumed by Py_BuildValue even on failure (CPython
     // modsupport.c releases them so they don't leak) — only the batch
     // state needs unwinding here, a DECREF would double-release `novel`
-    clear_batch(self);
+    batch_clear(bs);
   }
   return ret;
 }
 
-// Shared tail of both finish paths: per-block verdicts + batch reset.
-PyObject* verdict_and_clear(EngineObject* self) {
-  const uint64_t n_blocks = self->block_offs->size() - 1;
+// scan(witnesses) -> (novel list, miss, total) — classic protocol
+PyObject* Engine_scan(EngineObject* self, PyObject* witnesses) {
+  clear_batch(self);
+  PyObject* ret = scan_into(self, witnesses, self->batch);
+  if (ret) self->have_batch = 1;
+  return ret;
+}
+
+// Per-block verdicts over a batch state (GIL released around the C join).
+PyObject* batch_verdict(EngineObject* self, BatchState* bs) {
+  const uint64_t n_blocks = bs->block_offs.size() - 1;
   PyObject* out = PyBytes_FromStringAndSize(nullptr,
                                             static_cast<Py_ssize_t>(n_blocks));
   if (!out) return nullptr;
-  phant_engine_verdict(self->eng, self->rows->data(),
-                       self->block_offs->data(), n_blocks,
-                       self->roots->data(),
-                       reinterpret_cast<uint8_t*>(PyBytes_AS_STRING(out)));
+  uint8_t* obuf = reinterpret_cast<uint8_t*>(PyBytes_AS_STRING(out));
+  Py_BEGIN_ALLOW_THREADS
+  phant_engine_verdict(self->eng, bs->rows.data(), bs->block_offs.data(),
+                       n_blocks, bs->roots.data(), obuf);
+  Py_END_ALLOW_THREADS
+  return out;
+}
+
+// Shared tail of both classic finish paths: verdicts + batch reset.
+PyObject* verdict_and_clear(EngineObject* self) {
+  PyObject* out = batch_verdict(self, self->batch);
   clear_batch(self);
   return out;
+}
+
+// Commit a batch's novel nodes with caller digests (GIL released).
+// Returns 0, or -1 with an exception set.
+int batch_commit(EngineObject* self, BatchState* bs, PyObject* digests_obj) {
+  if (!bs->n_novel) return 0;
+  char* dbuf;
+  Py_ssize_t dlen;
+  if (digests_obj == Py_None ||
+      PyBytes_AsStringAndSize(digests_obj, &dbuf, &dlen) < 0) {
+    if (!PyErr_Occurred())
+      PyErr_SetString(PyExc_ValueError, "novel nodes need digests");
+    return -1;
+  }
+  if (static_cast<uint64_t>(dlen) != 32 * bs->n_novel) {
+    PyErr_SetString(PyExc_ValueError, "digests must be 32B per novel node");
+    return -1;
+  }
+  Py_BEGIN_ALLOW_THREADS
+  phant_engine_commit_ptrs(self->eng, bs->ptrs.data(), bs->lens.data(),
+                           bs->ptrs.size(), bs->rows.data(),
+                           bs->novel_idx.data(), bs->n_novel,
+                           reinterpret_cast<const uint8_t*>(dbuf));
+  Py_END_ALLOW_THREADS
+  return 0;
+}
+
+// Commit with in-C keccak of the novel nodes (GIL released: the commit
+// touches only raw pointers pinned by `keep` — a big novel batch, tens of
+// MB of keccak at startup/post-eviction, must not stall the Engine API's
+// other serving threads).
+void batch_commit_native(EngineObject* self, BatchState* bs) {
+  if (!bs->n_novel) return;
+  Py_BEGIN_ALLOW_THREADS
+  phant_engine_commit_hash_ptrs(self->eng, bs->ptrs.data(), bs->lens.data(),
+                                bs->ptrs.size(), bs->rows.data(),
+                                bs->novel_idx.data(), bs->n_novel);
+  Py_END_ALLOW_THREADS
 }
 
 // finish_native() -> verdict bytes; novel nodes are hashed IN C through
@@ -241,18 +315,7 @@ PyObject* Engine_finish_native(EngineObject* self, PyObject*) {
     PyErr_SetString(PyExc_RuntimeError, "finish_native() without a batch");
     return nullptr;
   }
-  if (self->n_novel) {
-    // the commit touches only raw pointers pinned by `keep` — release
-    // the GIL so a big novel batch (startup / post-eviction: tens of MB
-    // of keccak) does not stall the Engine API's other serving threads
-    // (engine-level exclusion is WitnessEngine._lock, already held)
-    Py_BEGIN_ALLOW_THREADS
-    phant_engine_commit_hash_ptrs(self->eng, self->ptrs->data(),
-                                  self->lens->data(), self->ptrs->size(),
-                                  self->rows->data(),
-                                  self->novel_idx->data(), self->n_novel);
-    Py_END_ALLOW_THREADS
-  }
+  batch_commit_native(self, self->batch);
   return verdict_and_clear(self);
 }
 
@@ -262,26 +325,155 @@ PyObject* Engine_finish(EngineObject* self, PyObject* digests_obj) {
     PyErr_SetString(PyExc_RuntimeError, "finish() without a scanned batch");
     return nullptr;
   }
-  if (self->n_novel) {
-    char* dbuf;
-    Py_ssize_t dlen;
-    if (digests_obj == Py_None ||
-        PyBytes_AsStringAndSize(digests_obj, &dbuf, &dlen) < 0) {
-      if (!PyErr_Occurred())
-        PyErr_SetString(PyExc_ValueError, "novel nodes need digests");
-      return nullptr;
-    }
-    if (static_cast<uint64_t>(dlen) != 32 * self->n_novel) {
-      PyErr_SetString(PyExc_ValueError, "digests must be 32B per novel node");
-      return nullptr;
-    }
-    phant_engine_commit_ptrs(self->eng, self->ptrs->data(),
-                             self->lens->data(), self->ptrs->size(),
-                             self->rows->data(), self->novel_idx->data(),
-                             self->n_novel,
-                             reinterpret_cast<const uint8_t*>(dbuf));
-  }
+  if (batch_commit(self, self->batch, digests_obj) < 0) return nullptr;
   return verdict_and_clear(self);
+}
+
+// --- pipelined protocol ----------------------------------------------------
+
+extern PyTypeObject BatchType;
+
+struct BatchObject {
+  PyObject_HEAD
+  EngineObject* owner;  // strong ref: a live batch pins its engine
+  BatchState* bs;
+  int finished;
+};
+
+void Batch_dealloc(BatchObject* self) {
+  if (self->bs) {
+    batch_clear(self->bs);
+    delete self->bs;
+  }
+  Py_CLEAR(self->owner);
+  Py_TYPE(self)->tp_free(reinterpret_cast<PyObject*>(self));
+}
+
+PyObject* Batch_n_novel(BatchObject* self, PyObject*) {
+  return PyLong_FromUnsignedLongLong(self->bs ? self->bs->n_novel : 0);
+}
+
+PyMethodDef Batch_methods[] = {
+    {"n_novel", reinterpret_cast<PyCFunction>(Batch_n_novel), METH_NOARGS,
+     "novel first occurrences in this batch"},
+    {nullptr, nullptr, 0, nullptr},
+};
+
+// scan_begin(witnesses) -> (Batch, novel, miss, total). Unlike scan(),
+// the batch state lives in the returned Batch object, so any number of
+// scanned batches can be outstanding (pipelining). Batches may finish in
+// ANY order — the tables are append-only, rows encode their own novel
+// indices, and a node novel in two outstanding batches commits a benign
+// duplicate row whichever lands first.
+PyObject* Engine_scan_begin(EngineObject* self, PyObject* witnesses) {
+  BatchObject* batch = PyObject_New(BatchObject, &BatchType);
+  if (!batch) return nullptr;
+  Py_INCREF(self);
+  batch->owner = self;
+  batch->bs = new BatchState();
+  batch->finished = 0;
+  PyObject* scanned = scan_into(self, witnesses, batch->bs);
+  if (!scanned) {
+    Py_DECREF(batch);
+    return nullptr;
+  }
+  // (novel, miss, total) -> (Batch, novel, miss, total)
+  PyObject* ret = PyTuple_New(4);
+  if (!ret) {
+    Py_DECREF(batch);
+    Py_DECREF(scanned);
+    return nullptr;
+  }
+  PyTuple_SET_ITEM(ret, 0, reinterpret_cast<PyObject*>(batch));
+  for (int i = 0; i < 3; ++i) {
+    PyObject* item = PyTuple_GET_ITEM(scanned, i);
+    Py_INCREF(item);
+    PyTuple_SET_ITEM(ret, i + 1, item);
+  }
+  Py_DECREF(scanned);
+  return ret;
+}
+
+BatchObject* checked_batch(EngineObject* self, PyObject* arg) {
+  if (!PyObject_TypeCheck(arg, &BatchType)) {
+    PyErr_SetString(PyExc_TypeError, "expected a Batch from scan_begin()");
+    return nullptr;
+  }
+  BatchObject* batch = reinterpret_cast<BatchObject*>(arg);
+  if (batch->owner != self) {
+    PyErr_SetString(PyExc_ValueError, "batch belongs to a different engine");
+    return nullptr;
+  }
+  if (batch->finished) {
+    PyErr_SetString(PyExc_RuntimeError, "batch already finished");
+    return nullptr;
+  }
+  return batch;
+}
+
+PyObject* batch_finish_tail(BatchObject* batch, PyObject* out) {
+  batch->finished = 1;
+  batch_clear(batch->bs);  // release the pinned witnesses promptly
+  return out;
+}
+
+// hash_batch(batch): keccak the batch's novel nodes into batch-local
+// digest storage — touches NO engine table, so callers run it WITHOUT
+// the engine lock (GIL released too): the resolve worker hashes batch N
+// here while the executor's scan_begin(N+1) probes the tables under the
+// lock. finish_batch(batch, None) then commits with the stored digests.
+PyObject* Engine_hash_batch(EngineObject* self, PyObject* arg) {
+  BatchObject* batch = checked_batch(self, arg);
+  if (!batch) return nullptr;
+  BatchState* bs = batch->bs;
+  if (bs->n_novel) {
+    bs->digests.resize(32 * bs->n_novel);
+    // batch-local ptr/len scratch (the Engine's scratch vectors belong
+    // to lock-holding calls; this one deliberately runs outside it)
+    std::vector<const uint8_t*> nptrs(bs->n_novel);
+    std::vector<uint32_t> nlens(bs->n_novel);
+    for (uint64_t k = 0; k < bs->n_novel; ++k) {
+      nptrs[k] = bs->ptrs[bs->novel_idx[k]];
+      nlens[k] = bs->lens[bs->novel_idx[k]];
+    }
+    Py_BEGIN_ALLOW_THREADS
+    phant_keccak256_ptrs_fast(nptrs.data(), nlens.data(), bs->n_novel,
+                              bs->digests.data());
+    Py_END_ALLOW_THREADS
+  }
+  Py_RETURN_NONE;
+}
+
+// finish_batch(batch, digests_or_None) -> verdict bytes. None is valid
+// when the batch had no novel nodes OR hash_batch() already filled the
+// batch-local digests.
+PyObject* Engine_finish_batch(EngineObject* self, PyObject* args) {
+  PyObject* batch_obj;
+  PyObject* digests_obj;
+  if (!PyArg_ParseTuple(args, "OO", &batch_obj, &digests_obj)) return nullptr;
+  BatchObject* batch = checked_batch(self, batch_obj);
+  if (!batch) return nullptr;
+  BatchState* bs = batch->bs;
+  if (digests_obj == Py_None && bs->n_novel &&
+      bs->digests.size() == 32 * bs->n_novel) {
+    Py_BEGIN_ALLOW_THREADS
+    phant_engine_commit_ptrs(self->eng, bs->ptrs.data(), bs->lens.data(),
+                             bs->ptrs.size(), bs->rows.data(),
+                             bs->novel_idx.data(), bs->n_novel,
+                             bs->digests.data());
+    Py_END_ALLOW_THREADS
+  } else if (batch_commit(self, bs, digests_obj) < 0) {
+    return nullptr;
+  }
+  return batch_finish_tail(batch, batch_verdict(self, batch->bs));
+}
+
+// finish_batch_native(batch) -> verdict bytes (in-C keccak of the novels)
+PyObject* Engine_finish_batch_native(EngineObject* self, PyObject* arg) {
+  BatchObject* batch = checked_batch(self, arg);
+  if (!batch) return nullptr;
+  batch_commit_native(self, batch->bs);
+  return batch_finish_tail(batch, batch_verdict(self, batch->bs));
 }
 
 PyObject* Engine_flush(EngineObject* self, PyObject*) {
@@ -305,6 +497,16 @@ PyMethodDef Engine_methods[] = {
      "finish(digests|None) -> verdict bytes"},
     {"finish_native", reinterpret_cast<PyCFunction>(Engine_finish_native),
      METH_NOARGS, "finish with in-C keccak of the novel nodes"},
+    {"scan_begin", reinterpret_cast<PyCFunction>(Engine_scan_begin), METH_O,
+     "scan_begin(witnesses) -> (Batch, novel, miss, total)"},
+    {"hash_batch", reinterpret_cast<PyCFunction>(Engine_hash_batch), METH_O,
+     "keccak the batch's novel nodes into batch-local digests (no "
+     "engine-table access: safe without the engine lock)"},
+    {"finish_batch", reinterpret_cast<PyCFunction>(Engine_finish_batch),
+     METH_VARARGS, "finish_batch(batch, digests|None) -> verdict bytes"},
+    {"finish_batch_native",
+     reinterpret_cast<PyCFunction>(Engine_finish_batch_native), METH_O,
+     "finish_batch(batch) with in-C keccak of the novel nodes"},
     {"flush", reinterpret_cast<PyCFunction>(Engine_flush), METH_NOARGS,
      "drop the interned generation"},
     {"nodes", reinterpret_cast<PyCFunction>(Engine_nodes), METH_NOARGS,
@@ -318,6 +520,12 @@ PyTypeObject EngineType = {
     PyVarObject_HEAD_INIT(nullptr, 0)
     "phant_engine_ext.Engine",           /* tp_name */
     sizeof(EngineObject),                /* tp_basicsize */
+};
+
+PyTypeObject BatchType = {
+    PyVarObject_HEAD_INIT(nullptr, 0)
+    "phant_engine_ext.Batch",            /* tp_name */
+    sizeof(BatchObject),                 /* tp_basicsize */
 };
 
 PyModuleDef moduledef = {
@@ -335,6 +543,11 @@ extern "C" PyObject* PyInit_phant_engine_ext() {
   EngineType.tp_methods = Engine_methods;
   EngineType.tp_new = Engine_new;
   if (PyType_Ready(&EngineType) < 0) return nullptr;
+  BatchType.tp_dealloc = reinterpret_cast<destructor>(Batch_dealloc);
+  BatchType.tp_flags = Py_TPFLAGS_DEFAULT;
+  BatchType.tp_methods = Batch_methods;
+  // Batch objects are created only by scan_begin(); no tp_new exposed
+  if (PyType_Ready(&BatchType) < 0) return nullptr;
   PyObject* m = PyModule_Create(&moduledef);
   if (!m) return nullptr;
   Py_INCREF(&EngineType);

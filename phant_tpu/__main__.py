@@ -109,6 +109,15 @@ def build_parser() -> argparse.ArgumentParser:
         help="Admission-queue bound; a full queue rejects with JSON-RPC "
         "-32050 (overload shedding) instead of building latency",
     )
+    p.add_argument(
+        "--sched-pipeline-depth",
+        type=int,
+        default=None,
+        help="Witness batches in flight between pack and resolve: depth "
+        ">= 2 overlaps host packing of batch N+1 with device compute / "
+        "digest resolve of batch N; 1 serializes (the pre-pipeline "
+        "behavior). Default: PHANT_SCHED_PIPELINE_DEPTH or 2",
+    )
     return p
 
 
@@ -155,11 +164,14 @@ def main(argv=None) -> int:
 
     from phant_tpu.serving import SchedulerConfig
 
-    sched_config = SchedulerConfig(
+    sched_kwargs = dict(
         max_batch=args.sched_max_batch,
         max_wait_ms=args.sched_max_wait_ms,
         queue_depth=args.sched_queue_depth,
     )
+    if args.sched_pipeline_depth is not None:
+        sched_kwargs["pipeline_depth"] = args.sched_pipeline_depth
+    sched_config = SchedulerConfig(**sched_kwargs)
     server = EngineAPIServer(
         chain,
         host=args.host,

@@ -13,6 +13,7 @@ from phant_tpu.analysis.core import Rule
 from phant_tpu.analysis.rules.dtype import DTypeRule
 from phant_tpu.analysis.rules.hostsync import HostSyncRule
 from phant_tpu.analysis.rules.jithygiene import JitHygieneRule
+from phant_tpu.analysis.rules.jnphostloop import JnpHostLoopRule
 from phant_tpu.analysis.rules.lock import LockRule
 from phant_tpu.analysis.rules.metricname import MetricNameRule
 from phant_tpu.analysis.rules.spanname import SpanNameRule
@@ -21,6 +22,7 @@ ALL_RULES = [
     HostSyncRule,
     DTypeRule,
     JitHygieneRule,
+    JnpHostLoopRule,
     LockRule,
     MetricNameRule,
     SpanNameRule,

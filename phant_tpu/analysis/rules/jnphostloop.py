@@ -1,0 +1,134 @@
+"""JNPHOSTLOOP: `jnp.*` calls inside host-side Python loops.
+
+A `jnp.*` call is one device dispatch. Inside a jitted function that is
+free — the Python loop unrolls at trace time into a single compiled
+program. Inside a plain `for`/`while` loop on the HOST it is a
+per-element device dispatch: every iteration pays the dispatch round
+trip (and usually runs a tiny kernel), the exact antipattern the batched
+/ vmapped hot loops exist to avoid. ROADMAP open item (c) asked for this
+rule once a refactor could plausibly reintroduce the pattern — the
+pipelined witness execution split (pack/dispatch/resolve across threads,
+PR 5) is that refactor: moving device calls between stages is precisely
+where a stray per-iteration `jnp.asarray` would creep in.
+
+Scope: functions that are neither jitted themselves nor reachable from a
+jitted function (reachable callees run traced, where host loops unroll
+at trace time). Calls are resolved to the `jax.numpy` namespace through
+any import alias (`import jax.numpy as jnp`, `from jax import numpy`,
+dotted `jax.numpy.foo`). Nested function definitions are separate scopes
+and are skipped (the symbol table does not track them — suppressing, not
+inventing, findings). The usual `# phantlint: disable=JNPHOSTLOOP`
+escape hatch applies to intentional per-iteration dispatches (e.g. a
+deliberately serialized device probe loop).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, Set
+
+from phant_tpu.analysis.core import Finding, Rule
+from phant_tpu.analysis.symbols import ModuleInfo, Project, _dotted
+
+_OWN_SCOPE = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda, ast.ClassDef)
+
+
+def _jnp_target(mi: ModuleInfo, call: ast.Call) -> str:
+    """The dotted callee when it resolves into jax.numpy, else ''."""
+    d = _dotted(call.func)
+    if d is None:
+        return ""
+    head, _, rest = d.partition(".")
+    target = mi.imports.get(head, head)
+    full = target + ("." + rest if rest else "")
+    if full == "jax.numpy" or full.startswith("jax.numpy."):
+        return d
+    return ""
+
+
+def _loop_calls(fn: ast.AST) -> Iterator[tuple]:
+    """(loop_kind, Call) for every call that executes PER ITERATION of a
+    For/While in `fn`, excluding nested function/class scopes. A for
+    loop's iterable expression and a loop's `else` clause run exactly
+    once — they inherit the surrounding context, never the loop's — while
+    a while loop's test re-evaluates every iteration and counts."""
+
+    def walk(node: ast.AST, in_loop: str) -> Iterator[tuple]:
+        if isinstance(node, _OWN_SCOPE):
+            return  # separate scope: analyzed (or not) on its own
+        if in_loop and isinstance(node, ast.Call):
+            yield in_loop, node
+        if isinstance(node, (ast.For, ast.AsyncFor)):
+            yield from walk(node.iter, in_loop)  # evaluated once
+            yield from walk(node.target, in_loop)
+            for stmt in node.body:
+                yield from walk(stmt, "for")
+            for stmt in node.orelse:
+                yield from walk(stmt, in_loop)  # runs once, after the loop
+            return
+        if isinstance(node, ast.While):
+            yield from walk(node.test, "while")  # re-evaluated per pass
+            for stmt in node.body:
+                yield from walk(stmt, "while")
+            for stmt in node.orelse:
+                yield from walk(stmt, in_loop)
+            return
+        if isinstance(
+            node, (ast.ListComp, ast.SetComp, ast.DictComp, ast.GeneratorExp)
+        ):
+            # the most idiomatic form of the antipattern:
+            # `[jnp.asarray(n) for n in nodes]` is one dispatch per
+            # element. The FIRST generator's iterable evaluates once;
+            # everything else — element expr, conditions, inner iters —
+            # runs per iteration.
+            gens = node.generators
+            yield from walk(gens[0].iter, in_loop)
+            for gen in gens:
+                yield from walk(gen.target, "comprehension")
+                for cond in gen.ifs:
+                    yield from walk(cond, "comprehension")
+            for gen in gens[1:]:
+                yield from walk(gen.iter, "comprehension")
+            if isinstance(node, ast.DictComp):
+                yield from walk(node.key, "comprehension")
+                yield from walk(node.value, "comprehension")
+            else:
+                yield from walk(node.elt, "comprehension")
+            return
+        for child in ast.iter_child_nodes(node):
+            yield from walk(child, in_loop)
+
+    for child in ast.iter_child_nodes(fn):
+        yield from walk(child, "")
+
+
+class JnpHostLoopRule(Rule):
+    name = "JNPHOSTLOOP"
+    description = "jnp calls inside host-side loops (per-element dispatch)"
+
+    def run(self, project: Project) -> Iterator[Finding]:
+        # traced scope: jitted functions plus everything they call — their
+        # loops unroll at trace time, one compiled program, no dispatch
+        jitted = [q for q, fi in project.functions.items() if fi.jitted]
+        traced: Set[str] = project.reachable(jitted)
+        for mi in project.modules.values():
+            funcs = list(mi.functions.values())
+            for ci in mi.classes.values():
+                funcs.extend(ci.methods.values())
+            for fi in funcs:
+                if fi.jitted or fi.qualname in traced:
+                    continue
+                for loop_kind, call in _loop_calls(fi.node):
+                    target = _jnp_target(mi, call)
+                    if not target:
+                        continue
+                    yield self.finding(
+                        project,
+                        mi,
+                        call,
+                        f"`{target}(...)` inside a host-side {loop_kind} "
+                        "loop — one device dispatch per iteration; "
+                        "batch/vmap the operation or hoist it out of the "
+                        "loop",
+                        context=fi.qualname,
+                    )

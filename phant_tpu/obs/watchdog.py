@@ -73,6 +73,9 @@ class Watchdog:
                 "sched.stall",
                 batch_id=st.get("batch_id"),
                 lane=st.get("lane"),
+                # which pipeline stage the wedged batch was in (pack/
+                # dispatch/resolve — serving/scheduler.py descriptors)
+                stage=st.get("stage"),
                 inflight_ms=round((now - st["started"]) * 1e3, 1),
                 overdue_ms=overdue_ms,
                 trace_ids=st.get("trace_ids"),

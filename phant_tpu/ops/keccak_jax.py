@@ -224,14 +224,45 @@ def digests_to_bytes(digests: np.ndarray) -> List[bytes]:
     return [arr[i].tobytes() for i in range(arr.shape[0])]
 
 
-def keccak256_batch_jax(payloads: Sequence[bytes], max_chunks: int | None = None) -> List[bytes]:
-    """Convenience end-to-end helper (host pack -> device hash -> bytes).
+class DeviceDigests:
+    """An UNRESOLVED batched-keccak dispatch: the device is (possibly
+    still) computing; `resolve()` performs the host readback — the honest
+    sync — and returns the digest list. The same async-dispatch shape as
+    secp256k1_jax.ecrecover_batch_async: enqueue now, pay the sync later,
+    so callers (the witness engine's pipelined resolve stage) overlap
+    host work of batch N+1 with device compute of batch N.
 
-    Dispatches through keccak256_chunked_auto (Pallas on real TPUs).
-    Counts batches/bytes per device platform and splits the upload+dispatch
-    timer from the forced-readback timer in the metrics registry."""
-    if not payloads:
-        return []
+    `on_resolve` (optional) runs after the readback — the witness engine
+    uses it to return its staging buffers to the reuse pool only once the
+    device can no longer be reading them."""
+
+    __slots__ = ("out", "n", "on_resolve")
+
+    def __init__(self, out, n: int, on_resolve=None):
+        self.out = out  # (B, 8) u32 device array, B >= n
+        self.n = n
+        self.on_resolve = on_resolve
+
+    def resolve(self) -> List[bytes]:
+        from phant_tpu.utils.trace import metrics
+
+        with metrics.phase("keccak.host_readback"):
+            # the timed readback IS the honest sync (see phase name)
+            digests = digests_to_bytes(np.asarray(self.out))[: self.n]  # phantlint: disable=HOSTSYNC — timed digest readback
+        if self.on_resolve is not None:
+            # fire ONCE: a second resolve() returning the same staging
+            # lease to the pool twice would alias buffers across batches
+            cb, self.on_resolve = self.on_resolve, None
+            cb()
+        return digests
+
+
+def keccak256_batch_jax_async(
+    payloads: Sequence[bytes], max_chunks: int | None = None
+) -> DeviceDigests:
+    """Enqueue a batched keccak on the device WITHOUT any host sync:
+    returns a DeviceDigests handle whose `resolve()` pays the readback.
+    `keccak256_batch_jax` is this plus an immediate resolve."""
     from phant_tpu.utils.trace import metrics
 
     platform = jax.default_backend()
@@ -242,5 +273,15 @@ def keccak256_batch_jax(payloads: Sequence[bytes], max_chunks: int | None = None
         out = keccak256_chunked_auto(
             jnp.asarray(words), jnp.asarray(nchunks), max_chunks=C
         )
-    with metrics.phase("keccak.host_readback"):
-        return digests_to_bytes(np.asarray(out))
+    return DeviceDigests(out, len(payloads))
+
+
+def keccak256_batch_jax(payloads: Sequence[bytes], max_chunks: int | None = None) -> List[bytes]:
+    """Convenience end-to-end helper (host pack -> device hash -> bytes).
+
+    Dispatches through keccak256_chunked_auto (Pallas on real TPUs).
+    Counts batches/bytes per device platform and splits the upload+dispatch
+    timer from the forced-readback timer in the metrics registry."""
+    if not payloads:
+        return []
+    return keccak256_batch_jax_async(payloads, max_chunks).resolve()

@@ -309,7 +309,9 @@ def trie_roots_device_batched(plans: List[HashPlan]) -> List[bytes]:
             ):
                 raise ValueError("batched plans must share structure")
     blobs = jnp.asarray(np.stack([p.blob for p in plans]))
-    levels_d = tuple(tuple(jnp.asarray(a) for a in lvl) for lvl in ref.levels)
+    # per-LEVEL metadata uploads, bounded by trie depth (~8 tiny arrays) —
+    # not a data-axis loop; the node axis itself ships in the one blob above
+    levels_d = tuple(tuple(jnp.asarray(a) for a in lvl) for lvl in ref.levels)  # phantlint: disable=JNPHOSTLOOP — bounded per-level metadata upload
     roots = _hash_plans_batched(blobs, levels_d, max_chunks=MPT_MAX_CHUNKS)
     arr = np.asarray(roots, dtype="<u4")
     return [arr[k].tobytes() for k in range(arr.shape[0])]
@@ -338,8 +340,9 @@ def trie_root_device(trie: Trie, plan: Optional[HashPlan] = None) -> bytes:
         return trie.root_hash()
 
     if plan.device_args is None:
+        # memoized ONCE per plan; bounded by trie depth like the batched twin
         levels_d = tuple(
-            tuple(jnp.asarray(a) for a in lvl) for lvl in plan.levels
+            tuple(jnp.asarray(a) for a in lvl) for lvl in plan.levels  # phantlint: disable=JNPHOSTLOOP — bounded per-level metadata upload
         )
         plan.device_args = (jnp.asarray(plan.blob), levels_d)
     blob_d, levels_d = plan.device_args

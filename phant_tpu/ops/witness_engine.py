@@ -53,6 +53,76 @@ from phant_tpu.ops.witness_jax import (
 _NO_ROW = np.int64(-1)
 
 
+class _HostStaging:
+    """Reusable host staging buffers, keyed by shape bucket.
+
+    The device hashing path pads both its axes to power-of-two buckets, so
+    steady-state batches land on a handful of distinct shapes — yet every
+    call used to allocate (and page-zero) a fresh padded blob. This pool
+    hands the same arrays back out instead: `take(key)` pops a free entry
+    (or returns None, caller allocates), `give(key, entry)` returns one
+    for reuse. Entries are dicts of arrays plus whatever dirty-watermark
+    the caller tracks; a borrowed entry is owned exclusively by its
+    borrower until given back, so pipelined batches in flight never alias
+    a buffer (each holds its own lease until its resolve stage)."""
+
+    def __init__(self, max_free_per_key: int = 4):
+        self._lock = threading.Lock()
+        self._free: Dict[tuple, List[dict]] = {}
+        self._max_free = max_free_per_key
+
+    def take(self, key: tuple) -> Optional[dict]:
+        with self._lock:
+            entries = self._free.get(key)
+            if entries:
+                return entries.pop()
+        return None
+
+    def give(self, key: tuple, entry: dict) -> None:
+        with self._lock:
+            entries = self._free.setdefault(key, [])
+            if len(entries) < self._max_free:
+                entries.append(entry)
+
+
+#: process-global staging pool (shapes are engine-independent)
+_staging = _HostStaging()
+
+
+class BatchHandle:
+    """One in-flight verify batch between `begin_batch` (pack + dispatch)
+    and `resolve_batch` (readback/hash + commit + linkage join). Opaque to
+    callers; `resolved` flips once the verdict has been returned."""
+
+    __slots__ = (
+        "kind",         # "ext" | "native" | "python"
+        "n_blocks",
+        "novel",        # list[bytes] to hash (empty: fully cached batch)
+        "n_novel",      # len(novel), preserved after resolve clears the list
+        "miss",
+        "total",
+        "ext_batch",    # ext core: the pyext Batch object
+        "rows",         # native/python cores: scan rows
+        "novel_idx",    # native core
+        "joined",       # native core: pins the packed blob
+        "blob",
+        "offsets",
+        "lens",
+        "pack_entry",   # native core: staging entry to return at resolve
+        "counts",       # per-block node counts (verdict composition)
+        "roots",        # concatenated roots (native) / witness list (python)
+        "witnesses",    # python core linkage join
+        "device",       # keccak_jax.DeviceDigests when dispatched async
+        "resolved",
+    )
+
+    def __init__(self):
+        for name in self.__slots__:
+            setattr(self, name, None)
+        self.novel = []
+        self.resolved = False
+
+
 def _extract_ref_digests(node: bytes) -> List[bytes]:
     """The 32-byte child hash references of one RLP trie node (branch
     children, extension child, account-leaf storage root). Malformed nodes
@@ -132,6 +202,23 @@ class WitnessEngine:
         self._hasher = hasher  # callable: List[bytes] -> List[bytes]
         self._device_batch_floor = device_batch_floor
         self._lock = threading.Lock()  # Engine API serves from threads
+        # pipelined two-phase state (begin_batch/resolve_batch), all
+        # guarded by _lock: the in-flight handle count and the deferred-
+        # eviction flag (a generation flush must never run while a
+        # scanned-but-uncommitted batch holds row ids — the tables it
+        # scanned against would vanish under it). _drained signals the
+        # count hitting zero, so an over-cap begin under SUSTAINED
+        # pipelined load can wait for a flush window instead of deferring
+        # forever (tables are append-only and commits re-check membership,
+        # so handles may begin/resolve in ANY interleaving — several
+        # schedulers can share one engine)
+        self._inflight = 0
+        self._drained = threading.Condition(self._lock)
+        self._evict_pending = False
+        # the python twin tables have their OWN deferred flag: on a
+        # C-core engine the public intern() fills _row_of_bytes, and its
+        # overflow must flush those dicts — not the warm memoized core
+        self._evict_pending_py = False
         self.stats = {"hashed": 0, "hits": 0, "evictions": 0}
 
     # -- hashing backends ---------------------------------------------------
@@ -145,17 +232,26 @@ class WitnessEngine:
     def _hash_batch_routed(
         self, nodes: List[bytes], route_device: Optional[bool] = None
     ) -> List[bytes]:
+        digests, backend = self._hash_novel(nodes, route_device)
+        if backend in ("device", "native"):
+            key = backend + "_batches"
+            self.stats[key] = self.stats.get(key, 0) + 1
+        return digests
+
+    def _hash_novel(
+        self, nodes: List[bytes], route_device: Optional[bool] = None
+    ) -> Tuple[List[bytes], str]:
+        """(digests, backend) with NO stats mutation — the pipelined
+        resolve stage hashes outside the engine lock and must account the
+        batch counter under it afterwards (a lock-free stats bump here
+        would race concurrent callers)."""
         if self._hasher is not None:
-            return list(self._hasher(nodes))
+            return list(self._hasher(nodes)), "hasher"
         if route_device is None:
             route_device = self._device_route_wanted(nodes)
         if route_device:
             try:
-                out = self._hash_batch_device(nodes)
-                self.stats["device_batches"] = (
-                    self.stats.get("device_batches", 0) + 1
-                )
-                return out
+                return self._hash_batch_device(nodes), "device"
             except Exception:
                 import logging
 
@@ -164,29 +260,33 @@ class WitnessEngine:
                     len(nodes),
                     exc_info=True,
                 )
-        self.stats["native_batches"] = self.stats.get("native_batches", 0) + 1
         from phant_tpu.utils.native import load_native
 
         native = load_native()
         if native is not None:
-            return list(native.keccak256_batch_fast(nodes))
+            return list(native.keccak256_batch_fast(nodes)), "native"
         from phant_tpu.crypto.keccak import keccak256
 
-        return [keccak256(n) for n in nodes]
+        return [keccak256(n) for n in nodes], "native"
 
     @staticmethod
-    def _hash_batch_device(nodes: List[bytes]) -> List[bytes]:
-        """One fused device dispatch: ship the concatenated novel bytes,
-        hash them with the chunked keccak kernel, read the digests back.
-        The transfer is the novel bytes + 2B/node — the memoized design
-        makes this the ONLY recurring h2d traffic of witness verification.
-        Both the node axis AND the blob byte axis are padded to power-of-two
-        buckets so repeat calls hit a small set of compiled shapes (a
-        ragged blob length would recompile per call)."""
+    def _device_dispatch(nodes: List[bytes]):
+        """Enqueue one fused device dispatch of the concatenated novel
+        bytes WITHOUT any host sync: returns a keccak_jax.DeviceDigests
+        handle whose `resolve()` pays the readback. The transfer is the
+        novel bytes + 2B/node — the memoized design makes this the ONLY
+        recurring h2d traffic of witness verification. Both the node axis
+        AND the blob byte axis are padded to power-of-two buckets so
+        repeat calls hit a small set of compiled shapes (a ragged blob
+        length would recompile per call) — and the padded staging arrays
+        themselves are leased from `_staging` keyed by that same bucket,
+        so steady-state batches stop reallocating (and page-zeroing) the
+        blob every call. The lease returns to the pool on resolve, when
+        the device can no longer be reading the buffers."""
         import jax.numpy as jnp
 
         from phant_tpu.crypto.keccak import RATE
-        from phant_tpu.ops.keccak_jax import digests_to_bytes
+        from phant_tpu.ops.keccak_jax import DeviceDigests
         from phant_tpu.ops.witness_jax import _pow2ceil, witness_digests
 
         limit = WITNESS_MAX_CHUNKS * RATE
@@ -198,12 +298,29 @@ class WitnessEngine:
                 )
         raw = b"".join(nodes)
         blob_len = _pow2ceil(len(raw) + WITNESS_MAX_CHUNKS * RATE)
-        blob = np.zeros(blob_len, np.uint8)
-        blob[: len(raw)] = np.frombuffer(raw, np.uint8)
         B = _pow2ceil(len(nodes))
-        lens = np.zeros(B, np.int32)
+        key = ("device_blob", blob_len, B)
+        entry = _staging.take(key)
+        if entry is None:
+            entry = {
+                "blob": np.zeros(blob_len, np.uint8),
+                "lens": np.zeros(B, np.int32),
+                "offsets": np.zeros(B, np.int32),
+                "blob_dirty": 0,
+                "lens_dirty": 0,
+            }
+        blob, lens, offsets = entry["blob"], entry["lens"], entry["offsets"]
+        # zero only the reused region past this batch's payload (a fresh
+        # allocation is already zero; the pool tracks the high-water mark)
+        if entry["blob_dirty"] > len(raw):
+            blob[len(raw) : entry["blob_dirty"]] = 0
+        if entry["lens_dirty"] > len(nodes):
+            lens[len(nodes) : entry["lens_dirty"]] = 0
+        blob[: len(raw)] = np.frombuffer(raw, np.uint8)
         lens[: len(nodes)] = [len(n) for n in nodes]
-        offsets = np.zeros(B, np.int32)
+        entry["blob_dirty"] = len(raw)
+        entry["lens_dirty"] = len(nodes)
+        offsets[0] = 0
         np.cumsum(lens[:-1], out=offsets[1:])
         import os
 
@@ -224,46 +341,81 @@ class WitnessEngine:
         # dispatch (upload + kernel launch) vs readback (the honest sync)
         # timed separately: on a tunneled chip the split localizes whether
         # the link or the kernel is eating the batch budget
-        with metrics.phase("keccak.device_dispatch"):
-            if use_sharded and len(jax.devices()) > 1 and B % len(jax.devices()) == 0:
-                # multi-chip novelty hashing: shard the node axis over the
-                # mesh (default-safe: the sharded compile's cache-suspension
-                # window is lock-serialized, see parallel/mesh.py)
-                from phant_tpu.parallel.mesh import (
-                    make_mesh,
-                    witness_digests_sharded,
-                )
+        try:
+            with metrics.phase("keccak.device_dispatch"):
+                if use_sharded and len(jax.devices()) > 1 and B % len(jax.devices()) == 0:
+                    # multi-chip novelty hashing: shard the node axis over
+                    # the mesh (default-safe: the sharded compile's cache-
+                    # suspension window is lock-serialized, parallel/mesh.py)
+                    from phant_tpu.parallel.mesh import (
+                        make_mesh,
+                        witness_digests_sharded,
+                    )
 
-                out = witness_digests_sharded(
-                    make_mesh(),
-                    blob,
-                    offsets,
-                    lens,
-                    max_chunks=WITNESS_MAX_CHUNKS,
-                )
-            else:
-                out = witness_digests(
-                    jnp.asarray(blob),
-                    jnp.asarray(offsets),
-                    jnp.asarray(lens),
-                    max_chunks=WITNESS_MAX_CHUNKS,
-                )
-        with metrics.phase("keccak.host_readback"):
-            # the timed readback IS the honest sync (see phase name)
-            return digests_to_bytes(np.asarray(out))[: len(nodes)]  # phantlint: disable=HOSTSYNC — timed digest readback
+                    out = witness_digests_sharded(
+                        make_mesh(),
+                        blob,
+                        offsets,
+                        lens,
+                        max_chunks=WITNESS_MAX_CHUNKS,
+                    )
+                else:
+                    out = witness_digests(
+                        jnp.asarray(blob),
+                        jnp.asarray(offsets),
+                        jnp.asarray(lens),
+                        max_chunks=WITNESS_MAX_CHUNKS,
+                    )
+        except BaseException:
+            # a failed enqueue (dead tunnel) must not strand the lease —
+            # the caller falls back to the native route and the buffers
+            # go back to the pool
+            _staging.give(key, entry)
+            raise
+        return DeviceDigests(
+            out, len(nodes), on_resolve=lambda: _staging.give(key, entry)
+        )
 
     @staticmethod
-    def _pack_blob(nodes: Sequence[bytes]):
+    def _hash_batch_device(nodes: List[bytes]) -> List[bytes]:
+        """Synchronous device hashing: dispatch + immediate readback (the
+        pipelined path keeps the DeviceDigests handle unresolved instead
+        so batch N+1 packs while batch N computes)."""
+        return WitnessEngine._device_dispatch(nodes).resolve()
+
+    @staticmethod
+    def _pack_blob(nodes: Sequence[bytes], entry: Optional[dict] = None):
         """(joined, blob u8, offsets u64, lens u32) C-ABI layout of a node
-        batch. `joined` must stay referenced while the views are in use."""
+        batch. `joined` must stay referenced while the views are in use.
+        With a staging `entry` (from `_pack_entry`), the offsets array is
+        a view into a pooled buffer instead of a fresh allocation — the
+        caller owns the entry until the views are dead."""
         n = len(nodes)
         joined = b"".join(nodes)
         blob = np.frombuffer(joined, np.uint8)
         lens = np.fromiter(map(len, nodes), np.uint32, n)
-        offsets = np.zeros(n, np.uint64)
+        if entry is not None and len(entry["offsets"]) >= n:
+            offsets = entry["offsets"][:n]
+            offsets[0:1] = 0
+        else:
+            offsets = np.zeros(n, np.uint64)
         if n > 1:
             np.cumsum(lens[:-1], dtype=np.uint64, out=offsets[1:])
         return joined, blob, offsets, lens
+
+    @staticmethod
+    def _pack_entry(n: int) -> Tuple[tuple, dict]:
+        """Lease a `_pack_blob` staging entry sized for `n` nodes (pow2
+        bucket). Return it with `_staging.give(key, entry)` once the blob
+        views are no longer referenced."""
+        from phant_tpu.ops.witness_jax import _pow2ceil
+
+        cap = _pow2ceil(max(n, 1))
+        key = ("pack_offsets", cap)
+        entry = _staging.take(key)
+        if entry is None:
+            entry = {"offsets": np.zeros(cap, np.uint64)}
+        return key, entry
 
     @staticmethod
     def _refs_for_batch(nodes: List[bytes]) -> Tuple[List[bytes], np.ndarray]:
@@ -329,14 +481,14 @@ class WitnessEngine:
         with self._lock:
             return self._intern_locked(nodes)
 
-    def _intern_locked(self, nodes: Sequence[bytes]) -> np.ndarray:
-        """Rows for `nodes`, hashing the never-seen ones in one batch.
-        Caller holds `self._lock`.
-
-        Each novel node's digest AND each of its child-reference digests are
-        interned to refids immediately, so linkage is fully resolved at
-        insert: a parent cached today links to a child that first arrives
-        as a node next week, because both map to the same refid."""
+    def _scan_rows_locked(
+        self, nodes: Sequence[bytes]
+    ) -> Tuple[np.ndarray, List[bytes], int]:
+        """(rows, novel, miss): the pure hit scan — NO table mutation, so
+        the pipelined pack stage can run it while earlier batches are
+        still uncommitted. rows[i] is a row id, or -2-k pointing into the
+        novel first-occurrence list; miss counts every negative entry
+        (novel duplicates included), the `hits` complement."""
         # bulk hit scan: one C-level map over the interning dict instead of
         # a Python loop with per-node numpy scalar writes — the steady
         # state is ~all hits, so this IS the verification hot path
@@ -346,9 +498,7 @@ class WitnessEngine:
             np.int64,
             n,
         )
-        hits_before = self.stats["hits"]
         miss_idx = np.nonzero(rows < 0)[0]
-        self.stats["hits"] += n - len(miss_idx)
         novel: List[bytes] = []
         seen_this_call: Dict[bytes, int] = {}
         for i in miss_idx.tolist():
@@ -360,26 +510,42 @@ class WitnessEngine:
             seen_this_call[nb] = len(novel)
             rows[i] = -2 - len(novel)
             novel.append(nb)
+        return rows, novel, len(miss_idx)
 
-        if novel:
-            if (
-                len(self._row_of_bytes) + len(novel) > self._max_nodes
-                and self._row_of_bytes  # an over-cap single batch still runs
-            ):
-                # the pass above is discarded — roll back its hit tally so
-                # the stats RPC doesn't double-count the re-interned scan
-                self.stats["hits"] = hits_before
-                self._evict_all()
-                # re-intern into the new generation (lock already held)
-                return self._intern_locked(nodes)
-            digests = self._hash_batch(novel)
-            ref_digests, ref_node = self._refs_for_batch(novel)
-            self.stats["hashed"] += len(novel)
-            self.stats["novel_bytes"] = self.stats.get("novel_bytes", 0) + sum(
-                map(len, novel)
-            )
+    def _commit_novel_locked(
+        self, rows: np.ndarray, novel: List[bytes], digests: List[bytes]
+    ) -> None:
+        """Insert `novel` (with caller-computed digests), intern every
+        digest + child reference, and patch the negative entries of `rows`
+        in place. Caller holds `self._lock`.
+
+        Each novel node's digest AND each of its child-reference digests
+        are interned to refids immediately, so linkage is fully resolved
+        at insert: a parent cached today links to a child that first
+        arrives as a node next week, because both map to the same refid.
+
+        A novel entry already present in the table — committed by an
+        earlier in-flight pipelined batch between this batch's scan and
+        now — reuses the existing row instead of inserting a duplicate."""
+        row_of_bytes = self._row_of_bytes
+        actual = np.empty(len(novel), np.int64)
+        fresh_idx: List[int] = []
+        for k, nb in enumerate(novel):
+            got = row_of_bytes.get(nb)
+            if got is None:
+                fresh_idx.append(k)
+            else:
+                actual[k] = got
+        if len(fresh_idx) == len(novel):
+            fresh, fresh_digests = novel, digests
+        else:
+            fresh = [novel[k] for k in fresh_idx]
+            fresh_digests = [digests[k] for k in fresh_idx]
+
+        if fresh:
+            ref_digests, ref_node = self._refs_for_batch(fresh)
             base_row = self._n_rows
-            self._n_rows += len(novel)
+            self._n_rows += len(fresh)
             self._grow(self._n_rows)
             self._child_refids[base_row : self._n_rows] = _NO_ROW  # gen reuse
 
@@ -405,7 +571,7 @@ class WitnessEngine:
             # for every digest in the batch (own digests first, then the
             # flat ref list); only genuinely new digests take the Python
             # assignment loop
-            all_dig = digests + ref_digests
+            all_dig = fresh_digests + ref_digests
             ids = np.fromiter(
                 map(self._refid_of_digest.get, all_dig, itertools.repeat(-1)),
                 np.int64,
@@ -424,17 +590,57 @@ class WitnessEngine:
                     ids[k] = got
                 self._n_refids = rid
 
-            nnovel = len(novel)
-            self._own_refid[base_row : base_row + nnovel] = ids[:nnovel]
+            nfresh = len(fresh)
+            self._own_refid[base_row : base_row + nfresh] = ids[:nfresh]
             if len(ref_node):
-                self._child_refids[base_row + ref_node, slots] = ids[nnovel:]
-            row_of_bytes = self._row_of_bytes
-            for k, nb in enumerate(novel):
-                row_of_bytes[nb] = base_row + k
-            # patch forward refs
-            neg = rows < -1
-            if neg.any():
-                rows[neg] = base_row + (-2 - rows[neg])
+                self._child_refids[base_row + ref_node, slots] = ids[nfresh:]
+            for j, nb in enumerate(fresh):
+                row_of_bytes[nb] = base_row + j
+            if len(fresh_idx) == len(novel):
+                actual[:] = base_row + np.arange(nfresh)
+            else:
+                actual[np.asarray(fresh_idx, np.int64)] = base_row + np.arange(
+                    nfresh
+                )
+
+        # patch forward refs through the actual-row map
+        neg = rows < -1
+        if neg.any():
+            rows[neg] = actual[-2 - rows[neg]]
+
+    def _intern_locked(self, nodes: Sequence[bytes]) -> np.ndarray:
+        """Rows for `nodes`, hashing the never-seen ones in one batch.
+        Caller holds `self._lock`."""
+        rows, novel, miss = self._scan_rows_locked(nodes)
+        hits_before = self.stats["hits"]
+        self.stats["hits"] += len(nodes) - miss
+        if novel:
+            if (
+                len(self._row_of_bytes) + len(novel) > self._max_nodes
+                and self._row_of_bytes  # an over-cap single batch still runs
+            ):
+                # NOT _over_cap_locked: this path interns into the PYTHON
+                # tables even on an engine whose verify path runs a C core
+                # (the public intern() entry), so the flush — immediate or
+                # deferred — must clear the python tables specifically;
+                # routing it to the core would leave _row_of_bytes full
+                # (and recurse forever) while wiping the warm core cache
+                if self._inflight:
+                    self._evict_pending_py = True
+                else:
+                    # the pass above is discarded — roll back its hit
+                    # tally so the stats RPC doesn't double-count the
+                    # re-interned scan
+                    self.stats["hits"] = hits_before
+                    self._evict_all()
+                    # re-intern into the new generation (lock already held)
+                    return self._intern_locked(nodes)
+            digests = self._hash_batch(novel)
+            self.stats["hashed"] += len(novel)
+            self.stats["novel_bytes"] = self.stats.get("novel_bytes", 0) + sum(
+                map(len, novel)
+            )
+            self._commit_novel_locked(rows, novel, digests)
         return rows
 
     # -- verification -------------------------------------------------------
@@ -458,6 +664,12 @@ class WitnessEngine:
         after release (the metrics lock never nests inside ours)."""
         with metrics.phase("witness_engine.verify_batch"):
             with self._lock:
+                # eviction-window wait FIRST (it releases the lock, see
+                # _pack_handle): only then is the s0 snapshot race-free
+                # against a concurrent resolver's already-published stats
+                self._await_evict_window_locked()
+                if not self._inflight:
+                    self._run_deferred_evictions_locked()
                 s0 = dict(self.stats)
                 verdict = self._verify_batch_locked(witnesses)
                 s1 = self.stats
@@ -483,9 +695,356 @@ class WitnessEngine:
         )
         return verdict
 
+    # -- pipelined two-phase API (pack / dispatch / resolve) -----------------
+
+    def begin_batch(
+        self, witnesses: Sequence[Tuple[bytes, Sequence[bytes]]]
+    ) -> BatchHandle:
+        """Pack + dispatch one verify batch WITHOUT the device round-trip:
+        the engine lock is held only for the intern-table scan (pack), the
+        device keccak of the novel nodes is enqueued with no host sync
+        (dispatch), and everything that needs the digests — readback,
+        commit, linkage join — waits for `resolve_batch`. Batch N+1 can
+        therefore pack while batch N computes and batch N-1 resolves (the
+        serving scheduler's pipeline, phant_tpu/serving/scheduler.py).
+
+        Handles may be resolved in ANY order (tables are append-only and
+        commits re-check membership, so interleavings — including several
+        schedulers sharing one engine — stay sound; the serving resolve
+        worker happens to be FIFO for per-requester ordering);
+        `verify_batch` remains the one-call depth-1 equivalent and may
+        interleave freely with in-flight handles."""
+        with metrics.phase("witness_engine.pack"):
+            h = self._pack_handle(witnesses)
+        with metrics.phase("witness_engine.dispatch"):
+            if h.novel and self._hasher is None and (
+                not self._native_route_certain()
+                and self._device_route_wanted(h.novel)
+            ):
+                try:
+                    h.device = self._device_dispatch(h.novel)
+                except Exception:
+                    import logging
+
+                    logging.getLogger("phant.witness").warning(
+                        "device keccak dispatch failed for %d nodes; "
+                        "native fallback at resolve",
+                        len(h.novel),
+                        exc_info=True,
+                    )
+        return h
+
+    def _pack_handle(self, witnesses) -> BatchHandle:
+        h = BatchHandle()
+        h.n_blocks = len(witnesses)
+        with self._lock:
+            # core refs are write-once in __init__; alias them under the
+            # lock once so the pre-lock assembly below branches on a
+            # consistent snapshot (LOCK discipline)
+            ext, core = self._ext_core, self._core
+        all_nodes: List[bytes] = []
+        if ext is None:
+            # host-side batch assembly + blob packing stays OUTSIDE the
+            # lock: it touches no engine table, and it is exactly the work
+            # the pipeline overlaps with the previous batch's resolve
+            counts = np.empty(h.n_blocks, np.int64)
+            for b, (_root, nodes) in enumerate(witnesses):
+                counts[b] = len(nodes)
+                all_nodes.extend(nodes)
+            h.counts = counts
+            if core is not None:
+                h.pack_entry = self._pack_entry(len(all_nodes))
+                h.joined, h.blob, h.offsets, h.lens = self._pack_blob(
+                    all_nodes, h.pack_entry[1]
+                )
+        with self._lock:
+            # the eviction-window wait RELEASES the lock: the stats
+            # snapshot for this batch's delta must come after it, or a
+            # concurrent resolver's flush (already published by its own
+            # resolve_batch) would be counted into the registry twice
+            self._await_evict_window_locked()
+            if not self._inflight:
+                self._run_deferred_evictions_locked()
+            evictions_before = self.stats["evictions"]
+            if ext is not None:
+                h.kind = "ext"
+                h.ext_batch, novel, miss, total = ext.scan_begin(witnesses)
+                if self._over_cap_locked(len(novel), ext.nodes()):
+                    h.ext_batch, novel, miss, total = ext.scan_begin(witnesses)
+                h.novel, h.miss, h.total = novel, miss, total
+            elif self._core is not None:
+                h.kind = "native"
+                core = self._core
+                rows, novel_idx, miss = core.scan(h.blob, h.offsets, h.lens)
+                if self._over_cap_locked(len(novel_idx), core.nodes):
+                    rows, novel_idx, miss = core.scan(h.blob, h.offsets, h.lens)
+                h.rows, h.novel_idx, h.miss = rows, novel_idx, miss
+                h.total = len(all_nodes)
+                h.novel = [all_nodes[i] for i in novel_idx.tolist()]
+                h.roots = b"".join(root for root, _nodes in witnesses)
+            else:
+                h.kind = "python"
+                rows, novel, miss = self._scan_rows_locked(all_nodes)
+                if self._over_cap_locked(len(novel), len(self._row_of_bytes)):
+                    rows, novel, miss = self._scan_rows_locked(all_nodes)
+                h.rows, h.novel, h.miss = rows, novel, miss
+                h.total = len(all_nodes)
+                h.witnesses = witnesses
+            self.stats["hits"] += h.total - h.miss
+            h.n_novel = len(h.novel)
+            if h.novel:
+                self.stats["hashed"] += len(h.novel)
+                self.stats["novel_bytes"] = self.stats.get(
+                    "novel_bytes", 0
+                ) + sum(map(len, h.novel))
+            self._inflight += 1
+            evictions_delta = self.stats["evictions"] - evictions_before
+        # registry publishes after release (the metrics lock never nests
+        # inside ours — same discipline as verify_batch)
+        if evictions_delta:
+            metrics.count("witness_engine.evictions", evictions_delta)
+        return h
+
+    def resolve_batch(self, handle: BatchHandle) -> np.ndarray:
+        """(n_blocks,) bool verdicts for a handle from `begin_batch`:
+        digest readback (device) or novel-node hashing (host — on THIS
+        thread, outside the engine lock, so a resolve worker's C keccak
+        overlaps the executor's next pack), then commit + linkage join
+        under the lock. Verdict semantics are byte-identical to
+        `verify_batch` over the same witnesses."""
+        with metrics.phase("witness_engine.resolve"):
+            verdict, snap = self._resolve_handle(handle)
+        if handle.total:
+            hits = handle.total - handle.miss
+            if hits:
+                metrics.count("witness_engine.cache_hits", hits)
+        metrics.gauge_set("witness_engine.interned_nodes", snap["interned_nodes"])
+        metrics.gauge_set(
+            "witness_engine.interned_digests", snap["interned_digests"]
+        )
+        return verdict
+
+    def abandon_batch(self, handle: BatchHandle) -> None:
+        """Release a handle WITHOUT committing it — the crash path.
+        Dropping a scanned batch is sound (commit is all-or-nothing under
+        the lock, so no table state is half-applied); what MUST not leak
+        is the pipeline bookkeeping: a stranded in-flight count would
+        defer generation flushes forever on a shared engine that outlives
+        a dead scheduler, growing the intern tables without bound.
+        Idempotent; called by resolve_batch's own pre-commit failure path
+        and by the serving scheduler's _die for dispatched-but-unresolved
+        handles."""
+        if handle.resolved:
+            return
+        handle.resolved = True
+        with self._lock:
+            self._release_inflight_locked()
+        if handle.pack_entry is not None:
+            # the commit that would have consumed the staging views is
+            # never coming: the lease goes straight back to the pool.
+            # (A device lease stays stranded — the enqueued compute may
+            # still be reading its buffers; bounded loss on a crash path.)
+            key, entry = handle.pack_entry
+            handle.blob = handle.offsets = handle.lens = handle.joined = None
+            _staging.give(key, entry)
+            handle.pack_entry = None
+        handle.novel = []
+        handle.witnesses = None
+        handle.ext_batch = None
+
+    def _resolve_handle(self, h: BatchHandle):
+        if h.resolved:
+            raise RuntimeError("batch handle already resolved")
+        digests: Optional[List[bytes]] = None
+        backend = None
+        n_novel = len(h.novel)
+        with self._lock:
+            # write-once core ref, aliased under the lock (LOCK
+            # discipline); the hashing below deliberately runs OUTSIDE it
+            ext = self._ext_core
+        # host-routed ext batches hash IN C into batch-local digest
+        # storage — same zero-Python-round-trip keccak as _verify_ext's
+        # finish_native, but split out so it runs WITHOUT the engine lock
+        # (GIL released too): the executor's next pack scans the tables
+        # concurrently. Any override or open offload gate surfaces the
+        # novel list to the Python-visible route instead.
+        ext_native_fast = (
+            h.kind == "ext" and n_novel > 0 and self._native_route_certain()
+        )
+        try:
+            if h.device is not None:
+                digests = h.device.resolve()  # the honest sync (keccak_jax)
+                backend = "device"
+            elif ext_native_fast:
+                backend = "native"
+                with metrics.phase("witness_engine.hash"):
+                    ext.hash_batch(h.ext_batch)
+            elif h.novel:
+                with metrics.phase("witness_engine.hash"):
+                    digests, backend = self._hash_novel(
+                        h.novel, route_device=False
+                    )
+        except BaseException:
+            # readback/hash died BEFORE any commit: release the handle so
+            # the pipeline bookkeeping (and deferred evictions) survive
+            self.abandon_batch(h)
+            raise
+        with self._lock:
+            evictions_before = self.stats["evictions"]
+            try:
+                if h.kind == "ext":
+                    with metrics.phase("witness_engine.linkage_join"):
+                        # digests=None: no novels, or hash_batch already
+                        # filled the batch-local digests (C side commits
+                        # straight from them)
+                        raw = self._ext_core.finish_batch(
+                            h.ext_batch,
+                            b"".join(digests) if digests else None,
+                        )
+                    verdict = np.frombuffer(raw, np.uint8).astype(bool)
+                elif h.kind == "native":
+                    if n_novel:
+                        self._core.commit(
+                            h.blob, h.offsets, h.lens, h.rows, h.novel_idx,
+                            b"".join(digests),
+                        )
+                    block_offs = np.zeros(h.n_blocks + 1, np.uint64)
+                    np.cumsum(h.counts, dtype=np.uint64, out=block_offs[1:])
+                    with metrics.phase("witness_engine.linkage_join"):
+                        verdict = self._core.verdict(h.rows, block_offs, h.roots)
+                else:
+                    if n_novel:
+                        self._commit_novel_locked(h.rows, h.novel, digests)
+                    with metrics.phase("witness_engine.linkage_join"):
+                        verdict = self._linkage_join(
+                            h.witnesses, h.rows, h.counts, h.n_blocks
+                        )
+                if backend in ("device", "native"):
+                    key = backend + "_batches"
+                    self.stats[key] = self.stats.get(key, 0) + 1
+            finally:
+                # a failed commit poisons THIS batch but must not wedge the
+                # pipeline bookkeeping (deferred evictions would never run)
+                h.resolved = True
+                self._release_inflight_locked()
+            evictions_delta = self.stats["evictions"] - evictions_before
+            snap = self._stats_snapshot_locked()
+        if evictions_delta:
+            # a resolve-drain flush counts like any other (pack publishes
+            # its delta the same way — the metric must not undercount)
+            metrics.count("witness_engine.evictions", evictions_delta)
+        if n_novel:
+            metrics.count("witness_engine.cache_misses", n_novel)
+            metrics.count(
+                "witness_engine.novel_bytes_hashed", sum(map(len, h.novel))
+            )
+        if h.pack_entry is not None:
+            # the staging offsets buffer is dead only now (commit/verdict
+            # consumed the views) — back to the pool for the next batch
+            key, entry = h.pack_entry
+            h.blob = h.offsets = h.lens = h.joined = None
+            _staging.give(key, entry)
+            h.pack_entry = None
+        h.resolved = True
+        h.novel = []
+        h.witnesses = None
+        h.ext_batch = None
+        return verdict, snap
+
+    def _release_inflight_locked(self) -> None:
+        """Drop one in-flight handle (resolve or abandon). When the
+        pipeline empties, run any deferred eviction RIGHT HERE — under
+        sustained pipelined load the executor's next begin overlaps this
+        resolve, so 'check at the next begin' alone can starve the flush
+        indefinitely and grow the tables without bound — and wake begins
+        waiting for a flush window."""
+        self._inflight -= 1
+        if self._inflight == 0:
+            self._run_deferred_evictions_locked()
+            self._drained.notify_all()
+
+    def _run_deferred_evictions_locked(self) -> None:
+        """Any deferred generation flushes, each against ITS tables.
+        Caller holds the lock with an empty pipeline."""
+        if self._evict_pending:
+            self._evict_pending = False
+            self._evict_now_locked()
+        if self._evict_pending_py:
+            # intern() on a C-core engine overfilled the python twin:
+            # flush those dicts only, never the warm core cache
+            self._evict_pending_py = False
+            self._evict_all()
+
+    def _interned_nodes_locked(self) -> int:
+        if self._ext_core is not None:
+            return self._ext_core.nodes()
+        if self._core is not None:
+            return self._core.nodes
+        return len(self._row_of_bytes)
+
+    def _await_evict_window_locked(self) -> None:
+        """Hard ceiling on deferred-eviction overshoot: when the tables
+        have grown past 2x max_nodes with a flush still pending, make the
+        over-cap begin WAIT (bounded) for the pipeline to drain instead
+        of deferring again — a saturated pipeline never has a natural
+        idle point, and unbounded deferral would unbound memory. The
+        timeout keeps a caller that begins without a concurrent resolver
+        (API misuse) degraded-but-alive rather than deadlocked."""
+        over_core = (
+            self._evict_pending
+            and self._interned_nodes_locked() > 2 * self._max_nodes
+        )
+        over_py = (
+            self._evict_pending_py
+            and len(self._row_of_bytes) > 2 * self._max_nodes
+        )
+        if not (self._inflight and (over_core or over_py)):
+            return
+        import time
+
+        deadline = time.monotonic() + 2.0
+        while self._inflight and time.monotonic() < deadline:
+            self._drained.wait(0.05)
+        # _release_inflight_locked already flushed if the pipe drained
+
+    def _over_cap_locked(self, n_novel: int, n_existing: int) -> bool:
+        """THE eviction policy, shared by every scan site (classic verify
+        paths and the pipelined pack stage): when this batch's novels
+        would cross `max_nodes` over a non-empty table, either flush now
+        (pipeline empty — returns True, caller MUST rescan against the
+        fresh generation) or defer (`_evict_pending`, handles in flight —
+        a flush would strand their scanned row ids; the flush then runs
+        at the next pipeline drain, see _release_inflight_locked)."""
+        if not (
+            n_novel
+            and n_existing  # an over-cap single batch still runs
+            and n_existing + n_novel > self._max_nodes
+        ):
+            return False
+        if self._inflight:
+            self._evict_pending = True
+            return False
+        self._evict_now_locked()
+        return True
+
+    def _evict_now_locked(self) -> None:
+        """Generation flush on whichever core is live. Caller holds the
+        lock AND has checked `self._inflight == 0` — flushing under an
+        outstanding pipelined batch would strand its scanned row ids."""
+        if self._ext_core is not None:
+            self.stats["evictions"] += 1
+            self._ext_core.flush()
+        elif self._core is not None:
+            self.stats["evictions"] += 1
+            self._core.flush()
+        else:
+            self._evict_all()
+
     def _verify_batch_locked(
         self, witnesses: Sequence[Tuple[bytes, Sequence[bytes]]]
     ) -> np.ndarray:
+        # deferred evictions already ran in verify_batch, BEFORE its
+        # stats snapshot (the eviction-window wait releases the lock)
         if self._ext_core is not None:
             return self._verify_ext(witnesses)
         n_blocks = len(witnesses)
@@ -511,9 +1070,7 @@ class WitnessEngine:
             novel, miss, total = st.scan(witnesses)
         n_novel = len(novel)
         if n_novel:
-            if st.nodes() + n_novel > self._max_nodes and st.nodes():
-                self.stats["evictions"] += 1
-                st.flush()
+            if self._over_cap_locked(n_novel, st.nodes()):
                 with metrics.phase("witness_engine.intern"):
                     novel, miss, total = st.scan(witnesses)
                 n_novel = len(novel)
@@ -606,9 +1163,7 @@ class WitnessEngine:
         with metrics.phase("witness_engine.intern"):
             rows, novel_idx, miss = core.scan(blob, offsets, lens)
         if len(novel_idx):
-            if core.nodes + len(novel_idx) > self._max_nodes and core.nodes:
-                self.stats["evictions"] += 1
-                core.flush()
+            if self._over_cap_locked(len(novel_idx), core.nodes):
                 with metrics.phase("witness_engine.intern"):
                     rows, novel_idx, miss = core.scan(blob, offsets, lens)
             novel = [all_nodes[i] for i in novel_idx.tolist()]

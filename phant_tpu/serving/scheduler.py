@@ -28,19 +28,44 @@ shape instead:
   while the previous batch executed IS the next batch); the wait only
   costs anything for a request arriving at an idle executor, which is
   why it bounds — and is the whole of — the serial-client latency tax.
-* **Executor** — ONE thread drains buckets into
-  `WitnessEngine.verify_batch` (the amortized engine/device dispatch)
-  and resolves per-request futures. The same thread runs *serial* jobs
+* **Executor** — ONE thread drains buckets into the engine and resolves
+  per-request futures. The same thread runs *serial* jobs
   (state-mutating `engine_newPayload*` execution) one at a time, in
   admission order — which is what replaces the server's global execution
   lock: mutation is serialized by the executor, not by a mutex held
   across the whole request.
+* **Pipeline** (`pipeline_depth`, default 2 via
+  PHANT_SCHED_PIPELINE_DEPTH / `--sched-pipeline-depth`) — with depth
+  >= 2 the executor splits witness execution through the engine's
+  two-phase API (ops/witness_engine.py `begin_batch`/`resolve_batch`):
+  it PACKS batch N+1 (bucket assembly + lock-held intern scan) and
+  DISPATCHES its novel-node keccak with no host sync while a dedicated
+  *resolve worker* thread RESOLVES batch N (digest readback / GIL-free C
+  hashing outside the engine lock, then commit + linkage join). JAX's
+  async dispatch means the device was idle during host packing and the
+  host idle during device compute — this is the overlap that closes it,
+  the same double-buffered-prefetch shape inference servers use. At
+  most `pipeline_depth` batches are in flight; the executor blocks on a
+  full pipeline (`sched.pipeline_stall` names resolve as the
+  bottleneck). Depth 1 — or an engine without `begin_batch` — is the
+  pre-pipeline behavior, byte-identical inline verify_batch execution.
+  The serial lane drains the WHOLE pipeline first, so mutation stays
+  exclusive against in-flight witness work; futures still complete in
+  admission order per requester (the resolve worker is FIFO). On crash
+  paths, dispatched-but-unresolved handles are released through the
+  engine's `abandon_batch` (when it has one) so a shared engine that
+  outlives a dead scheduler never leaks in-flight leases. Handle
+  resolution order is a per-scheduler property only — the engine accepts
+  any interleaving, so several schedulers can share one engine.
 * **Lifecycle** — `shutdown(drain=True)` stops admission and lets the
-  executor finish everything queued (graceful drain); an exception
-  escaping batch execution marks the scheduler DOWN: the crashed batch
-  and everything queued fail fast with `SchedulerDown` (`-32052`), later
-  submits are rejected immediately, and `/healthz` reports 503 with
-  `executor_alive: false` (engine_api/server.py `_healthz_payload`).
+  executor finish everything queued AND everything in the pipeline
+  (graceful drain); an exception escaping batch execution — in either
+  thread — marks the scheduler DOWN: the crashed batch, everything
+  queued, and every dispatched-but-unresolved handle fail fast with
+  `SchedulerDown` (`-32052`), later submits are rejected immediately,
+  `/healthz` reports 503 with `executor_alive: false`
+  (engine_api/server.py `_healthz_payload`), and the crash flight
+  record names the pipeline STAGE that died (pack/dispatch/resolve).
 
 `verify_many()` is the synchronous offline face of the same machinery:
 bench.py, the spec runner (`--sched`), and tests push whole witness
@@ -70,10 +95,11 @@ ops/witness_engine.py).
 from __future__ import annotations
 
 import logging
+import os
 import threading
 import time
 from concurrent.futures import Future
-from dataclasses import dataclass
+from dataclasses import dataclass, field
 from typing import Callable, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -111,6 +137,13 @@ class SchedulerDown(SchedulerError):
     code = -32052
 
 
+def _default_pipeline_depth() -> int:
+    """PHANT_SCHED_PIPELINE_DEPTH, default 2 (overlap pack of batch N+1
+    with resolve of batch N). Depth 1 is the pre-pipeline serialized
+    behavior: the executor runs pack -> dispatch -> resolve inline."""
+    return int(os.environ.get("PHANT_SCHED_PIPELINE_DEPTH", "2"))
+
+
 @dataclass
 class SchedulerConfig:
     """Knobs, surfaced as `--sched-*` CLI flags (phant_tpu/__main__.py)."""
@@ -119,6 +152,10 @@ class SchedulerConfig:
     max_wait_ms: float = 5.0  # assembly wait for an under-full batch
     queue_depth: int = 512  # admission-queue bound (overload -> QueueFull)
     deadline_ms: float = 30_000.0  # default per-request deadline; <=0 = none
+    # witness batches in flight between pack and resolve (>=2 pipelines:
+    # the executor packs/dispatches batch N+1 while the resolve worker
+    # reads back + joins batch N); 1 = today's serialized execution
+    pipeline_depth: int = field(default_factory=_default_pipeline_depth)
 
 
 _WITNESS = "witness"
@@ -133,6 +170,40 @@ def _pow2ceil(n: int) -> int:
     while p < max(n, 1):
         p *= 2
     return p
+
+
+def _safe_resolve(future: Future, result) -> None:
+    """set_result tolerating a concurrent _die: with two scheduler threads,
+    the resolve worker can complete a batch in the same instant the
+    executor fails everything — losing that race must not raise
+    InvalidStateError out of the winner."""
+    try:
+        future.set_result(result)
+    except Exception:
+        pass  # already failed by _die; the waiter got the crash
+
+
+def _safe_fail(future: Future, exc: BaseException) -> None:
+    if not future.done():
+        try:
+            future.set_exception(exc)
+        except Exception:
+            pass  # resolved in the race window; the waiter got a verdict
+
+
+def _abandon_handle(engine, handle) -> None:
+    """Release a dispatched-but-unresolved engine handle on a crash path.
+    The shared engine outlives a dead scheduler; a leaked handle would
+    pin its in-flight count and defer generation flushes forever
+    (ops/witness_engine.py abandon_batch). Best-effort: the scheduler is
+    already dying, a second failure here must not mask the first."""
+    abandon = getattr(engine, "abandon_batch", None)
+    if abandon is None:
+        return
+    try:
+        abandon(handle)
+    except Exception:
+        log.warning("abandon_batch failed on a crash path", exc_info=True)
 
 
 @dataclass
@@ -175,6 +246,7 @@ class VerificationScheduler:
         self._max_batch = self.config.max_batch
         self._max_wait_s = self.config.max_wait_ms / 1e3
         self._queue_depth = self.config.queue_depth
+        self._pipe_depth = max(1, self.config.pipeline_depth)
         self._engine = engine
         # chaos drill (obs): PHANT_SCHED_CHAOS_CRASH=1 makes the FIRST
         # witness batch crash the executor — the supported way to fire-
@@ -188,10 +260,17 @@ class VerificationScheduler:
         self._queue: List[_Job] = []
         self._closed = False
         self._dead: Optional[BaseException] = None
-        # observability: monotone batch ids + the in-flight descriptor the
-        # obs watchdog polls (both guarded by _lock)
+        # observability: monotone batch ids + the in-flight descriptors the
+        # obs watchdog polls, oldest first (all guarded by _lock). With
+        # pipelining, up to pipeline_depth witness batches are in flight.
         self._batch_seq = 0
-        self._inflight: Optional[dict] = None
+        self._inflight_list: List[dict] = []
+        # pipeline state (guarded by _lock): items awaiting the resolve
+        # worker, whether it is mid-resolve, and the stage the executor is
+        # in (named by the crash record when the executor dies)
+        self._resolve_q: List[dict] = []
+        self._resolving = False
+        self._exec_stage = "pack"
         self.stats = {
             "requests": 0,
             "batches": 0,
@@ -199,12 +278,20 @@ class VerificationScheduler:
             "coalesced": 0,
             "batched_requests": 0,
             "max_batch_seen": 0,
+            "pipelined_batches": 0,
             "rejected": 0,
         }
+        metrics.gauge_set("sched.pipeline_depth", self._pipe_depth)
         self._thread = threading.Thread(
             target=self._run, name="phant-sched-exec", daemon=True
         )
         self._thread.start()
+        self._resolve_thread: Optional[threading.Thread] = None
+        if self._pipe_depth > 1:
+            self._resolve_thread = threading.Thread(
+                target=self._resolve_run, name="phant-sched-resolve", daemon=True
+            )
+            self._resolve_thread.start()
         self._watchdog = Watchdog(self.inflight_state).start()
 
     # -- context manager (offline verify_many use) ---------------------------
@@ -349,9 +436,9 @@ class VerificationScheduler:
         the offline API for bench.py, the spec runner, and tests. Blocks on
         queue space instead of rejecting (offline callers want completion,
         not load shedding) and applies no deadline."""
-        if threading.current_thread() is self._thread:
+        if threading.current_thread() in (self._thread, self._resolve_thread):
             raise RuntimeError(
-                "verify_many called from the executor thread (deadlock)"
+                "verify_many called from a scheduler thread (deadlock)"
             )
         futs = [
             self.submit_witness(
@@ -365,10 +452,11 @@ class VerificationScheduler:
 
     def accepts_witness(self) -> bool:
         """Can the CURRENT thread route a witness verification through this
-        scheduler? False on the executor thread itself (submitting from it
-        would deadlock: it is the only consumer) and once the scheduler is
-        down or draining — callers fall back to the direct engine path."""
-        if threading.current_thread() is self._thread:
+        scheduler? False on the executor/resolve threads themselves
+        (submitting from either would deadlock: they are the consumers)
+        and once the scheduler is down or draining — callers fall back to
+        the direct engine path."""
+        if threading.current_thread() in (self._thread, self._resolve_thread):
             return False
         with self._lock:
             return self._dead is None and not self._closed
@@ -380,12 +468,19 @@ class VerificationScheduler:
         with self._lock:
             depth = len(self._queue)
             dead = self._dead
+            inflight = len(self._resolve_q) + (1 if self._resolving else 0)
         alive = dead is None and self._thread.is_alive()
+        if self._resolve_thread is not None:
+            # a dead resolve worker is just as fatal as a dead executor:
+            # dispatched handles would never complete
+            alive = alive and self._resolve_thread.is_alive()
         out = {
             "queue_depth": depth,
             "executor_alive": alive,
             "max_batch": self.config.max_batch,
             "max_wait_ms": self.config.max_wait_ms,
+            "pipeline_depth": self._pipe_depth,
+            "pipeline_inflight": inflight,
         }
         if dead is not None:
             out["error"] = repr(dead)
@@ -396,14 +491,17 @@ class VerificationScheduler:
             st = dict(self.stats)
         b = st["batches"]
         st["mean_batch"] = round(st["batched_requests"] / b, 2) if b else 0.0
+        st["pipeline_depth"] = self._pipe_depth
         return st
 
     def inflight_state(self) -> Optional[dict]:
-        """The batch the executor is inside right now — `batch_id`, `lane`,
-        `started`/`deadline` (monotonic), `trace_ids` — or None when idle.
-        Polled by the obs watchdog to flag deadline-overrun stalls."""
+        """The OLDEST batch currently in flight — `batch_id`, `lane`,
+        `stage`, `started`/`deadline` (monotonic), `trace_ids` — or None
+        when idle. Polled by the obs watchdog to flag deadline-overrun
+        stalls; with pipelining the oldest unresolved batch is the one a
+        wedged device call strands first."""
         with self._lock:
-            return dict(self._inflight) if self._inflight is not None else None
+            return dict(self._inflight_list[0]) if self._inflight_list else None
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -422,6 +520,8 @@ class VerificationScheduler:
                 SchedulerDown("scheduler shut down before execution")
             )
         self._thread.join(timeout)
+        if self._resolve_thread is not None:
+            self._resolve_thread.join(timeout)
         self._watchdog.stop(1.0)
         metrics.gauge_set("sched.queue_depth", 0)
 
@@ -433,16 +533,38 @@ class VerificationScheduler:
             while True:
                 batch = self._next_batch()
                 if batch is None:
+                    # graceful exit: every dispatched handle must resolve
+                    # before the executor reports done (shutdown drains the
+                    # admission queue AND the in-flight pipeline)
+                    self._drain_pipeline()
+                    with self._lock:
+                        self._exec_done = True
+                        self._cond.notify_all()
                     return
                 self._execute(batch)
                 batch = []
         except BaseException as e:  # systemic: engine/internal failure
-            self._die(e, batch or [])
+            self._die(e, batch or [], stage=self._exec_stage)
+
+    _exec_done = False  # executor returned cleanly (resolve worker exits)
+
+    def _drain_pipeline(self) -> None:
+        """Block until every dispatched handle has resolved (or the
+        scheduler died). Called by the executor before serial jobs —
+        the serial lane stays exclusive with ALL witness work, not just
+        the executor's own — and on graceful shutdown."""
+        with self._lock:
+            while (self._resolve_q or self._resolving) and self._dead is None:
+                self._cond.wait(0.05)
 
     def _next_batch(self) -> Optional[List[_Job]]:
         with self._lock:
             while True:
                 self._expire_locked()
+                if self._dead is not None:
+                    # the resolve worker died and failed everything: exit
+                    # instead of idling in wait() until shutdown
+                    return None
                 if self._queue:
                     break
                 if self._closed:
@@ -531,32 +653,75 @@ class VerificationScheduler:
         else:
             stall_deadline = None
         trace_ids = [j.trace_id for j in batch]
+        pipelined = False
+        if lane == _SERIAL:
+            # serial exclusivity covers the PIPELINE too: a state mutation
+            # must not run while dispatched witness handles are in flight
+            self._exec_stage = "serial"
+            self._drain_pipeline()
+            with self._lock:
+                dead = self._dead
+            if dead is not None:
+                # the drain ended because the scheduler DIED, not because
+                # the pipeline emptied: a state mutation must not commit
+                # on a server whose /healthz already reports it down
+                _safe_fail(
+                    batch[0].future,
+                    SchedulerDown(f"scheduler executor crashed: {dead!r}"),
+                )
+                return
+            stage = "serial"
+        else:
+            self._exec_stage = "pack"  # provisional: engine resolution
+            engine = self._resolve_engine()
+            pipelined = self._pipe_depth > 1 and hasattr(engine, "begin_batch")
+            # stage vocabulary: pipelined batches move pack -> dispatch ->
+            # resolve; a depth-1/inline batch runs all three fused under
+            # "dispatch" (the engine round-trip the executor blocks on).
+            # _exec_stage must AGREE with the batch_start record — a
+            # depth-1 crash (chaos drill included) has no pack stage
+            stage = "pack" if pipelined else "dispatch"
+            self._exec_stage = stage
         with self._lock:
             self._batch_seq += 1
             batch_id = self._batch_seq
-            self._inflight = {
-                "batch_id": batch_id,
-                "lane": lane,
-                "started": now,
-                "deadline": stall_deadline,
-                "trace_ids": trace_ids,
-            }
+            self._inflight_list.append(
+                {
+                    "batch_id": batch_id,
+                    "lane": lane,
+                    "stage": stage,
+                    "started": now,
+                    "deadline": stall_deadline,
+                    "trace_ids": trace_ids,
+                }
+            )
         flight.record(
             "sched.batch_start",
             batch_id=batch_id,
             lane=lane,
+            stage=stage,
             batch_size=len(batch),
             bucket_bytes=batch[0].bucket if lane == _WITNESS else None,
             trace_ids=trace_ids,
         )
+        if pipelined:
+            # the descriptor stays in flight until the resolve worker
+            # finishes the batch (or _die clears everything)
+            self._execute_witness_pipelined(batch, batch_id, engine, now)
+            return
         try:
             if lane == _SERIAL:
                 self._execute_serial(batch[0], batch_id)
             else:
-                self._execute_witness(batch, batch_id)
+                self._execute_witness(batch, batch_id, engine, now)
         finally:
             with self._lock:
-                self._inflight = None
+                self._drop_inflight_locked(batch_id)
+
+    def _drop_inflight_locked(self, batch_id: int) -> None:
+        self._inflight_list = [
+            d for d in self._inflight_list if d["batch_id"] != batch_id
+        ]
 
     def _execute_serial(self, job: _Job, batch_id: int) -> None:
         metrics.count("sched.batches", lane="serial")
@@ -603,24 +768,29 @@ class VerificationScheduler:
         except Exception:
             return None
 
-    def _execute_witness(self, batch: List[_Job], batch_id: int) -> None:
-        now = time.monotonic()
+    def _shed_or_keep(self, batch: List[_Job], now: float) -> List[_Job]:
         jobs = []
         for j in batch:
             if j.deadline is not None and now > j.deadline:
                 self._shed_expired(j)
             else:
                 jobs.append(j)
+        return jobs
+
+    def _execute_witness(
+        self, batch: List[_Job], batch_id: int, engine, picked: float
+    ) -> None:
+        """Depth-1/inline execution: one verify_batch round-trip on the
+        executor thread (pack + dispatch + resolve fused) — exactly the
+        pre-pipeline behavior."""
+        jobs = self._shed_or_keep(batch, picked)
         if not jobs:
             return
-        n = len(jobs)
-        total = sum(j.nbytes for j in jobs)
-        padded = _pow2ceil(total)
         if self._chaos_crash:
             raise RuntimeError(
                 "chaos drill: PHANT_SCHED_CHAOS_CRASH=1 induced executor crash"
             )
-        engine = self._resolve_engine()
+        self._exec_stage = "dispatch"
         s0 = self._engine_cache_stats(engine)
         # the engine/device dispatch this scheduler exists for: one
         # verify_batch over the whole coalesced bucket. An exception here
@@ -632,8 +802,9 @@ class VerificationScheduler:
         s1 = self._engine_cache_stats(engine)
         record = {
             "batch_id": batch_id,
-            "batch_size": n,
+            "batch_size": len(jobs),
             "bucket_bytes": jobs[0].bucket,
+            "stage": "dispatch",
         }
         if s0 is not None and s1 is not None:
             # deltas are batch-attributable as long as this executor is the
@@ -647,19 +818,97 @@ class VerificationScheduler:
                 record["backend"] = "native"
             else:
                 record["backend"] = "cached"  # zero novel nodes: no hashing
+        self._finish_witness_jobs(jobs, verdicts, record, picked)
+
+    def _execute_witness_pipelined(
+        self, batch: List[_Job], batch_id: int, engine, picked: float
+    ) -> None:
+        """Pack + dispatch on the executor thread, resolve on the resolve
+        worker: begin_batch holds the engine lock only for the intern
+        scan and enqueues the device keccak with NO host sync, so this
+        thread moves straight on to assembling (and packing) the next
+        batch while the device computes and the worker resolves."""
+        jobs = self._shed_or_keep(batch, picked)
+        if not jobs:
+            with self._lock:
+                self._drop_inflight_locked(batch_id)
+            return
+        if self._chaos_crash:
+            raise RuntimeError(
+                "chaos drill: PHANT_SCHED_CHAOS_CRASH=1 induced executor crash"
+            )
+        # bounded depth: wait for a pipeline slot (stall time is the
+        # occupancy signal — a hot resolve stage shows up here). The depth
+        # is an immutable config scalar, read lock-free like the others.
+        depth = self._pipe_depth
+        t_wait = time.perf_counter()
+        with self._lock:
+            while (
+                len(self._resolve_q) + (1 if self._resolving else 0) >= depth
+                and self._dead is None
+            ):
+                self._cond.wait(0.05)
+            dead = self._dead
+        metrics.observe("sched.pipeline_stall", time.perf_counter() - t_wait)
+        if dead is not None:
+            # the resolve worker died while we waited: fail this batch the
+            # same way _die failed everything else, and stop the executor
+            raise SchedulerDown(f"resolve worker is down: {dead!r}")
+        # deadlines re-checked AFTER the slot wait: a wedged resolve stage
+        # can hold the pipeline full long past a job's deadline, and an
+        # expired job must shed (its waiter is gone) rather than spend
+        # pack/dispatch/resolve work
+        jobs = self._shed_or_keep(jobs, time.monotonic())
+        if not jobs:
+            with self._lock:
+                self._drop_inflight_locked(batch_id)
+            return
+        t_pack = time.perf_counter()
+        handle = engine.begin_batch([(j.root, j.nodes) for j in jobs])
+        item = {
+            "jobs": jobs,
+            "handle": handle,
+            "batch_id": batch_id,
+            "picked": picked,
+            "pack_ms": round((time.perf_counter() - t_pack) * 1e3, 3),
+        }
+        with self._lock:
+            dead = self._dead
+            if dead is None:
+                self._resolve_q.append(item)
+        if dead is not None:
+            # the worker died while we packed: the just-begun handle will
+            # never be resolved — release its engine lease before failing
+            _abandon_handle(engine, handle)
+            raise SchedulerDown(f"resolve worker is down: {dead!r}")
+        with self._lock:
+            self.stats["pipelined_batches"] += 1
+            inflight = len(self._resolve_q) + (1 if self._resolving else 0)
+            self._cond.notify_all()
+        metrics.gauge_set("sched.pipeline_inflight", inflight)
+
+    def _finish_witness_jobs(
+        self, jobs: List[_Job], verdicts, record: dict, picked: float
+    ) -> None:
+        """Shared completion tail of both witness paths: per-job meta +
+        future resolution, the batch_done flight record, and the batching
+        metrics/stats."""
+        n = len(jobs)
+        total = sum(j.nbytes for j in jobs)
+        padded = _pow2ceil(total)
         done = time.monotonic()
         for j, ok in zip(jobs, verdicts):
             # meta BEFORE set_result: a waiter that observed the verdict
             # must also observe its batch record (verify_traced)
             j.meta = {
                 **record,
-                "queue_wait_ms": round((now - j.admitted) * 1e3, 3),
+                "queue_wait_ms": round((picked - j.admitted) * 1e3, 3),
             }
-            j.future.set_result(bool(ok))
+            _safe_resolve(j.future, bool(ok))
         flight.record(
             "sched.batch_done",
             lane=_WITNESS,
-            duration_ms=round((done - now) * 1e3, 3),
+            duration_ms=round((done - picked) * 1e3, 3),
             n_ok=int(sum(bool(ok) for ok in verdicts)),
             trace_ids=[j.trace_id for j in jobs],
             **record,
@@ -680,6 +929,80 @@ class VerificationScheduler:
             if n > st["max_batch_seen"]:
                 st["max_batch_seen"] = n
 
+    # -- resolve worker (pipeline_depth > 1) ---------------------------------
+
+    def _resolve_run(self) -> None:
+        item: Optional[dict] = None
+        try:
+            while True:
+                with self._lock:
+                    while (
+                        not self._resolve_q
+                        and not self._exec_done
+                        and self._dead is None
+                    ):
+                        self._cond.wait()
+                    if self._dead is not None:
+                        return  # _die already failed everything queued
+                    if not self._resolve_q:
+                        return  # executor done and the pipeline is drained
+                    item = self._resolve_q.pop(0)
+                    self._resolving = True
+                    for d in self._inflight_list:
+                        if d["batch_id"] == item["batch_id"]:
+                            d["stage"] = "resolve"
+                    self._cond.notify_all()
+                try:
+                    self._resolve_one(item)
+                finally:
+                    with self._lock:
+                        self._resolving = False
+                        self._drop_inflight_locked(item["batch_id"])
+                        inflight = len(self._resolve_q)
+                        self._cond.notify_all()
+                    metrics.gauge_set("sched.pipeline_inflight", inflight)
+                item = None
+        except BaseException as e:  # systemic: readback/commit failure
+            # resolve_batch releases its own handle on failure; a crash
+            # elsewhere in the loop still must not leak it
+            if item is not None:
+                _abandon_handle(self._engine, item["handle"])
+            self._die(e, item["jobs"] if item else [], stage="resolve")
+
+    def _resolve_one(self, item: dict) -> None:
+        jobs = item["jobs"]
+        handle = item["handle"]
+        t0 = time.monotonic()
+        verdicts = self._engine.resolve_batch(handle)
+        # the batch record comes from the HANDLE, not an engine-stats
+        # delta: with batches overlapping in the pipeline, a delta would
+        # blend batch N's resolve with batch N+1's pack
+        record = {
+            "batch_id": item["batch_id"],
+            "batch_size": len(jobs),
+            "bucket_bytes": jobs[0].bucket,
+            "stage": "resolve",
+            "pack_ms": item["pack_ms"],
+        }
+        total = getattr(handle, "total", None)
+        miss = getattr(handle, "miss", None)
+        # cache_misses = UNIQUE novel nodes hashed (n_novel), matching the
+        # inline path's hashed-delta semantics — `miss` also counts
+        # within-batch duplicate occurrences and would make identical
+        # traffic read differently across pipeline depths
+        n_novel = getattr(handle, "n_novel", None)
+        if total is not None and miss is not None:
+            record["cache_hits"] = total - miss
+            record["cache_misses"] = n_novel if n_novel is not None else miss
+        if getattr(handle, "device", None) is not None:
+            record["backend"] = "device"
+        elif n_novel if n_novel is not None else miss:
+            record["backend"] = "native"
+        else:
+            record["backend"] = "cached"  # zero novel nodes: no hashing
+        record["resolve_ms"] = round((time.monotonic() - t0) * 1e3, 3)
+        self._finish_witness_jobs(jobs, verdicts, record, item["picked"])
+
     def _resolve_engine(self):
         if self._engine is None:
             from phant_tpu.stateless import shared_witness_engine
@@ -687,30 +1010,54 @@ class VerificationScheduler:
             self._engine = shared_witness_engine()
         return self._engine
 
-    def _die(self, exc: BaseException, batch: List[_Job]) -> None:
-        log.error("scheduler executor crashed: %r", exc, exc_info=exc)
-        metrics.count("sched.executor_crashes")
+    def _die(
+        self, exc: BaseException, batch: List[_Job], stage: Optional[str] = None
+    ) -> None:
+        """Mark the scheduler DOWN and fail fast: the crashing batch, every
+        queued job, AND every dispatched-but-unresolved pipeline handle.
+        `stage` names where execution died — pack/dispatch (executor),
+        resolve (resolve worker), serial — so the postmortem pinpoints the
+        pipeline stage. Idempotent-by-first-caller: when the second thread
+        of a pipelined scheduler trips over the first thread's corpse, it
+        only fails its own victims (one crash record, one dump)."""
         with self._lock:
-            self._dead = exc
+            first = self._dead is None
+            if first:
+                self._dead = exc
             victims = batch + self._queue
+            dropped_items = list(self._resolve_q)
+            for item in dropped_items:
+                victims.extend(item["jobs"])
             self._queue = []
+            self._resolve_q = []
+            self._inflight_list = []
             batch_id = self._batch_seq
             self._cond.notify_all()
-        # the postmortem FIRST: record the crash (with the crashing batch's
-        # ids) and dump the whole ring to build/flight/ — by the time a
-        # waiter observes its SchedulerDown, the artifact already exists
-        flight.record(
-            "sched.executor_crash",
-            batch_id=batch_id,
-            error=repr(exc),
-            crashed_trace_ids=[j.trace_id for j in batch],
-            n_failed_fast=len(victims),
-        )
-        flight.dump("executor_crash")
+        engine = self._engine
+        for item in dropped_items:
+            # never resolved, never will be: release the engine leases so
+            # a shared engine keeps evicting after this scheduler's death
+            _abandon_handle(engine, item["handle"])
+        if first:
+            log.error("scheduler executor crashed: %r", exc, exc_info=exc)
+            metrics.count("sched.executor_crashes")
+            # the postmortem FIRST: record the crash (with the crashing
+            # batch's ids and the stage that died) and dump the whole ring
+            # to build/flight/ — by the time a waiter observes its
+            # SchedulerDown, the artifact already exists
+            flight.record(
+                "sched.executor_crash",
+                batch_id=batch_id,
+                stage=stage,
+                error=repr(exc),
+                crashed_trace_ids=[j.trace_id for j in batch],
+                n_failed_fast=len(victims),
+            )
+            flight.dump("executor_crash")
         for j in victims:
-            if not j.future.done():
-                j.future.set_exception(
-                    SchedulerDown(f"scheduler executor crashed: {exc!r}")
-                )
+            _safe_fail(
+                j.future, SchedulerDown(f"scheduler executor crashed: {exc!r}")
+            )
         metrics.gauge_set("sched.queue_depth", 0)
+        metrics.gauge_set("sched.pipeline_inflight", 0)
         self._watchdog.stop(0.0)

@@ -500,15 +500,20 @@ def verify_witness_nodes(state_root: bytes, nodes: List[bytes]) -> bool:
     Serving mode: when a continuous-batching scheduler is installed
     (phant_tpu/serving/ — the Engine API server installs one), the check
     routes through it so concurrent handler threads coalesce into ONE
-    `verify_batch` engine/device dispatch instead of paying a batch-of-1
-    each. The batch record the executor attaches (batch_id, batch_size,
-    bucket_bytes, backend, cache hit/miss, queue_wait_ms) folds into the
-    caller's open span, so the request's `verify_block` trace names the
-    shared dispatch that served it (phant_tpu/obs/). Scheduler rejections
-    (queue full, deadline, executor down) propagate as SchedulerError for
-    the server to map to JSON-RPC errors. Without a scheduler — offline
-    tools, tests, the spec runner by default — the direct shared-engine
-    path is unchanged."""
+    engine/device dispatch instead of paying a batch-of-1 each — and with
+    `pipeline_depth >= 2` (the default) that dispatch is PIPELINED: the
+    executor packs batch N+1 while batch N computes on the device and
+    batch N-1 resolves (ops/witness_engine.py begin_batch/resolve_batch).
+    The batch record the scheduler attaches (batch_id, batch_size,
+    bucket_bytes, backend, cache hit/miss, queue_wait_ms, and for
+    pipelined batches the stage + pack_ms/resolve_ms split) folds into
+    the caller's open span, so the request's `verify_block` trace names
+    the shared dispatch that served it AND the pipeline stage timings it
+    rode (phant_tpu/obs/). Scheduler rejections (queue full, deadline,
+    executor down) propagate as SchedulerError for the server to map to
+    JSON-RPC errors. Without a scheduler — offline tools, tests, the
+    spec runner by default — the direct shared-engine path is
+    unchanged."""
     if state_root == EMPTY_TRIE_ROOT:
         # the empty pre-state needs (and admits) no witness nodes — same
         # contract as the host BFS (mpt/proof.py verify_witness_linked)

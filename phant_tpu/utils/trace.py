@@ -156,6 +156,10 @@ METRIC_HELP: Dict[str, str] = {
     "witness_engine.intern": "Interning/scan phase of verify_batch (cache probe + table insert)",
     "witness_engine.hash": "Novel-node keccak phase of verify_batch (includes the C-side commit+join on the finish_native fast path)",
     "witness_engine.linkage_join": "Parent->child linkage join / verdict phase of verify_batch",
+    # pipelined two-phase engine API (begin_batch/resolve_batch)
+    "witness_engine.pack": "Pack stage: host batch assembly + lock-held intern-table scan (begin_batch)",
+    "witness_engine.dispatch": "Dispatch stage: device keccak enqueue of the novel nodes, no host sync (begin_batch)",
+    "witness_engine.resolve": "Resolve stage: digest readback/hash outside the lock + commit + linkage join (resolve_batch)",
     # continuous-batching scheduler (phant_tpu/serving/)
     "sched.queue_depth": "Verification requests currently in the scheduler admission queue",
     "sched.batch_size": "Assembled witness-batch sizes (requests per engine dispatch)",
@@ -165,6 +169,9 @@ METRIC_HELP: Dict[str, str] = {
     "sched.batches": "Scheduler executions by lane (witness batches / serial jobs)",
     "sched.padding_waste": "Unused fraction of the padded device buffer the last witness batch would occupy",
     "sched.executor_crashes": "Scheduler executor crashes (scheduler marked down, /healthz -> 503)",
+    "sched.pipeline_depth": "Configured pipeline depth (1 = serialized pack/dispatch/resolve, the pre-pipeline behavior)",
+    "sched.pipeline_inflight": "Witness batches currently between begin_batch and resolve_batch",
+    "sched.pipeline_stall": "Executor waits for a free pipeline slot (resolve stage is the bottleneck)",
     # observability layer (phant_tpu/obs/)
     "sched.watchdog_stalls": "Executor stalls detected by the obs watchdog (in-flight batch past its deadline)",
     "flight.dumps": "Flight-recorder postmortem dumps written, by trigger reason",

@@ -20,16 +20,22 @@ digging. `make trend` turns them into a trajectory:
   * a latest round whose artifact is missing/unparseable (`parsed: null`,
     rc != 0) is itself a flagged finding — a dead artifact is the worst
     regression of all (that IS the r05 failure);
-  * MULTICHIP artifacts contribute an ok/rc health row.
+  * MULTICHIP artifacts contribute an ok/rc health row;
+  * KNOWN-dead artifacts can be ACKNOWLEDGED (`--ack BENCH_r05`, or one
+    stem per line in a committed `BENCH_ACK` file next to the artifacts,
+    `#` comments allowed) once they are root-caused: an acked artifact
+    reports an `acked` row instead of failing strict mode forever —
+    which is what lets check.sh run the strict gate instead of
+    --report-only. An ack is a statement that the cause is understood
+    AND fixed; a NEW dead round still flags.
 
 Exit status: 1 when anything is flagged, 0 otherwise; `--report-only`
-always exits 0 (scripts/check.sh runs that mode so the commit gate shows
-the trend without going red on box noise — the driver-side consumer can
-run the strict mode).
+always exits 0.
 
 Usage:
     python scripts/benchtrend.py [--dir .] [--threshold 0.4]
                                  [--min-prior 2] [--report-only] [--json]
+                                 [--ack STEM ...]
 """
 
 from __future__ import annotations
@@ -94,11 +100,33 @@ def _series(rounds: List[Tuple[int, dict]]) -> Dict[str, List[Tuple[int, float]]
     return series
 
 
+def load_acks(dirpath: str) -> List[str]:
+    """Acknowledged artifact stems from `<dir>/BENCH_ACK`: one stem per
+    line (e.g. `BENCH_r05`), `#` starts a comment (inline or full-line).
+    A missing file means no acks."""
+    path = os.path.join(dirpath, "BENCH_ACK")
+    if not os.path.exists(path):
+        return []
+    out: List[str] = []
+    with open(path) as f:
+        for line in f:
+            stem = line.split("#", 1)[0].strip()
+            if stem:
+                out.append(stem)
+    return out
+
+
 def analyze(
-    dirpath: str, threshold: float, min_prior: int
+    dirpath: str,
+    threshold: float,
+    min_prior: int,
+    acks: Tuple[str, ...] = (),
 ) -> Tuple[List[dict], List[str]]:
-    """(rows, flags): the per-metric trend table and the flagged findings."""
+    """(rows, flags): the per-metric trend table and the flagged findings.
+    `acks` (plus the committed BENCH_ACK file) suppresses the dead-
+    artifact flag for root-caused rounds."""
     rounds = load_rounds(dirpath, _BENCH_RE)
+    acked = set(acks) | set(load_acks(dirpath))
     flags: List[str] = []
     rows: List[dict] = []
     if not rounds:
@@ -108,12 +136,25 @@ def analyze(
     # artifact health first: a round with no parseable artifact is the
     # regression that hides every other one (BENCH_r05: rc=124, parsed null)
     if not isinstance(latest_rec.get("parsed"), dict):
-        flags.append(
-            f"BENCH_r{latest_n:02d}: no parseable artifact "
-            f"(rc={latest_rec.get('rc')}, parsed="
-            f"{'null' if latest_rec.get('parsed') is None else 'invalid'}) — "
-            "the round produced NO bench data"
-        )
+        stem = f"BENCH_r{latest_n:02d}"
+        if stem in acked:
+            rows.append(
+                {
+                    "metric": "artifact_health",
+                    "rounds": len(rounds),
+                    "latest": f"{stem} dead (acked)",
+                    "direction": "info",
+                    "verdict": "acked",
+                }
+            )
+        else:
+            flags.append(
+                f"{stem}: no parseable artifact "
+                f"(rc={latest_rec.get('rc')}, parsed="
+                f"{'null' if latest_rec.get('parsed') is None else 'invalid'}) — "
+                "the round produced NO bench data (ack it in BENCH_ACK once "
+                "root-caused)"
+            )
 
     # metric comparisons run against the newest round that HAS data (when
     # the newest round's artifact is dead, the health flag above already
@@ -169,8 +210,13 @@ def analyze(
         ever_ok = any(r.get("ok") for _n, r in multi[:-1])
         # a skipped round is not a regression: keep the row verdict and the
         # strict-mode flag on the SAME condition or the report and the exit
-        # code would contradict each other
-        multi_red = not mrec.get("ok") and ever_ok and not mrec.get("skipped")
+        # code would contradict each other. Acked rounds report, not flag.
+        multi_red = (
+            not mrec.get("ok")
+            and ever_ok
+            and not mrec.get("skipped")
+            and f"MULTICHIP_r{mn:02d}" not in acked
+        )
         rows.append(
             {
                 "metric": "multichip_ok",
@@ -235,12 +281,23 @@ def main(argv=None) -> int:
     p.add_argument(
         "--report-only",
         action="store_true",
-        help="always exit 0 (the check.sh mode: show the trend, never gate)",
+        help="always exit 0 (show the trend, never gate)",
+    )
+    p.add_argument(
+        "--ack",
+        action="append",
+        default=[],
+        metavar="STEM",
+        help="acknowledge a known-dead artifact (e.g. BENCH_r05) so it "
+        "stops failing strict mode; the committed BENCH_ACK file is the "
+        "durable form",
     )
     p.add_argument("--json", action="store_true", help="machine-readable output")
     args = p.parse_args(argv)
 
-    rows, flags = analyze(args.dir, args.threshold, args.min_prior)
+    rows, flags = analyze(
+        args.dir, args.threshold, args.min_prior, tuple(args.ack)
+    )
     if args.json:
         print(json.dumps({"rows": rows, "flags": flags}, indent=1))
     else:

@@ -37,6 +37,10 @@ DEVICE_GROUPS=(
 )
 CORE_IGNORES=()
 for f in "${DEVICE_GROUPS[@]}"; do CORE_IGNORES+=("--ignore=$f"); done
+# serving/obs run in their OWN depth-pinned groups below (once per
+# pipeline depth) — running them in core too would be a third, redundant
+# pass over the same tests
+CORE_IGNORES+=("--ignore=tests/test_serving.py" "--ignore=tests/test_obs.py")
 
 start=$(date +%s)
 fail=0
@@ -71,6 +75,15 @@ run_group() {
 }
 
 run_group core tests/ "${CORE_IGNORES[@]}" "$@"
+
+# The serving/obs groups run with the pipeline depth PINNED at both
+# ends: =2 guarantees the pipelined pack/dispatch/resolve path is
+# exercised on every commit even if the config default ever changes, =1
+# pins the pre-pipeline serialized path (tests that need a specific depth
+# set it in their own SchedulerConfig and are immune to the env). The
+# core group ignores these files, so each runs exactly twice.
+PHANT_SCHED_PIPELINE_DEPTH=2 run_group serving_pipelined tests/test_serving.py tests/test_obs.py "$@"
+PHANT_SCHED_PIPELINE_DEPTH=1 run_group serving_depth1 tests/test_serving.py tests/test_obs.py "$@"
 if [ "${PHANT_CHECK_DEVICE:-1}" != "0" ]; then
   for f in "${DEVICE_GROUPS[@]}"; do
     run_group "$(basename "$f" .py)" "$f" "$@"
@@ -91,14 +104,16 @@ rc=$?
 echo "[check] group soak: rc=$rc in $(( $(date +%s) - t0 ))s"
 if [ "$rc" -ne 0 ]; then cat build/logs/soak.log; fail=1; fi
 
-# Bench-trend sentinel, report-only: surface per-section deltas across the
-# committed BENCH_r*/MULTICHIP_r* artifacts in every gate run without
-# going red on shared-box noise (`make trend` is the strict mode).
+# Bench-trend sentinel, STRICT: the committed BENCH_ACK file carries the
+# root-caused dead artifacts (BENCH_r05), so the sentinel can finally be
+# a real gate — a new dead round or a beyond-noise-bar section regression
+# goes red here instead of hiding in a report nobody reads.
 t0=$(date +%s)
-python scripts/benchtrend.py --report-only > build/logs/trend.log 2>&1
+python scripts/benchtrend.py > build/logs/trend.log 2>&1
 rc=$?
-echo "[check] group trend (report-only): rc=$rc in $(( $(date +%s) - t0 ))s"
+echo "[check] group trend (strict): rc=$rc in $(( $(date +%s) - t0 ))s"
 tail -n 5 build/logs/trend.log | sed 's/^/[trend] /'
+if [ "$rc" -ne 0 ]; then cat build/logs/trend.log; fail=1; fi
 
 total=$(( $(date +%s) - start ))
 if [ "$fail" -ne 0 ]; then

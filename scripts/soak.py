@@ -161,7 +161,10 @@ def main() -> int:
             print(f"[soak] FAIL: {f}", file=sys.stderr)
         return 1
     print("[soak] green: no errors, clean drain")
-    return _crash_phase()
+    rc = _crash_phase()
+    if rc:
+        return rc
+    return _pipeline_phase()
 
 
 def _crash_phase() -> int:
@@ -249,6 +252,125 @@ def _crash_phase() -> int:
     print(
         f"[soak] crash phase green: {len(new_dumps)} flight dump(s), "
         f"postmortem names the crashing batch ({crash_dumps[0]})"
+    )
+    return 0
+
+
+def _pipeline_phase() -> int:
+    """Pipelined-execution soak (PR 5): the same witness span at pipeline
+    depth 2 vs depth 1 must produce byte-identical verdicts offline, and
+    an induced RESOLVE-stage crash at depth 2 must fail exactly the
+    in-flight handles (-32052) while the already-resolved batches keep
+    their VALID verdicts and the crash dump names the resolve stage."""
+    import json
+
+    from phant_tpu.obs.flight import flight
+    from phant_tpu.ops.witness_engine import WitnessEngine
+    from phant_tpu.serving import (
+        SchedulerConfig,
+        SchedulerDown,
+        VerificationScheduler,
+    )
+
+    from test_serving import _witness_set
+
+    failures: list = []
+    wits = _witness_set(128, trie_size=512, picks=8, seed=11)
+
+    outs = {}
+    for depth in (1, 2):
+        with VerificationScheduler(
+            engine=WitnessEngine(),
+            config=SchedulerConfig(
+                max_batch=16, max_wait_ms=10.0, queue_depth=4096,
+                pipeline_depth=depth,
+            ),
+        ) as s:
+            outs[depth] = s.verify_many(wits)
+            st = s.stats_snapshot()
+            if depth == 2 and st["pipelined_batches"] < 1:
+                failures.append(f"depth-2 soak never pipelined: {st}")
+    if not (outs[1] == outs[2]).all() or not outs[1].all():
+        failures.append("depth-2 verdicts diverge from depth-1")
+
+    class _PoisonedResolve:
+        """Healthy until ARMED (after the pre-crash futures complete, so
+        the phase is immune to how many batches the assembler formed),
+        then the next resolve dies — the wedged-readback failure mode."""
+
+        def __init__(self):
+            self._eng = WitnessEngine()
+            self.armed = False
+
+        def verify_batch(self, w):
+            return self._eng.verify_batch(w)
+
+        def begin_batch(self, w):
+            return self._eng.begin_batch(w)
+
+        def abandon_batch(self, h):
+            self._eng.abandon_batch(h)
+
+        def resolve_batch(self, h):
+            if self.armed:
+                raise RuntimeError("soak-induced resolve crash")
+            return self._eng.resolve_batch(h)
+
+    flight_dir = os.environ.get(
+        "PHANT_FLIGHT_DIR",
+        os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            "build",
+            "flight",
+        ),
+    )
+    before = set(os.listdir(flight_dir)) if os.path.isdir(flight_dir) else set()
+    poisoned = _PoisonedResolve()
+    s = VerificationScheduler(
+        engine=poisoned,
+        config=SchedulerConfig(max_batch=8, max_wait_ms=5.0, pipeline_depth=2),
+    )
+    try:
+        first = [s.submit_witness(*w) for w in wits[:8]]
+        if not all(f.result(timeout=30) for f in first):
+            failures.append("pre-crash batch not VALID")
+        poisoned.armed = True
+        second = [s.submit_witness(*w) for w in wits[8:16]]
+        for f in second:
+            try:
+                f.result(timeout=30)
+                failures.append("in-flight handle survived resolve crash")
+            except SchedulerDown as e:
+                if e.code != -32052:
+                    failures.append(f"wrong down code: {e.code}")
+        if not all(f.result(timeout=1) for f in first):
+            failures.append("already-resolved verdicts lost after crash")
+    finally:
+        s.shutdown()
+    new_dumps = sorted(set(os.listdir(flight_dir)) - before)
+    crash_dumps = [d for d in new_dumps if "executor_crash" in d]
+    if not crash_dumps:
+        failures.append(f"no resolve-crash flight dump ({new_dumps})")
+    else:
+        with open(os.path.join(flight_dir, crash_dumps[-1])) as f:
+            dump = json.load(f)
+        crashes = [
+            r for r in dump.get("records", [])
+            if r.get("kind") == "sched.executor_crash"
+        ]
+        if not crashes or crashes[-1].get("stage") != "resolve":
+            failures.append(
+                f"crash dump does not name the resolve stage: "
+                f"{crashes[-1] if crashes else None}"
+            )
+
+    if failures:
+        for f in failures:
+            print(f"[soak] FAIL (pipeline phase): {f}", file=sys.stderr)
+        return 1
+    print(
+        "[soak] pipeline phase green: depth-2 byte-identical, resolve-stage "
+        "crash fails only in-flight handles and names its stage"
     )
     return 0
 

@@ -98,6 +98,72 @@ def test_dead_artifact_is_flagged_and_table_falls_back(tmp_path):
     assert row["verdict"] == "ok" and row["latest"] == 990.0
 
 
+def test_acked_dead_artifact_reports_but_does_not_flag(tmp_path):
+    """The BENCH_ACK graduation contract: a root-caused dead round stops
+    failing strict mode forever — via the committed BENCH_ACK file or
+    --ack — but it still shows in the table as an `acked` row, and a NEW
+    dead round is NOT covered by an old ack."""
+    for n, v in enumerate([1000.0, 1020.0, 990.0], start=1):
+        _write_round(tmp_path, n, {"engine_cpu_blocks_per_sec": v})
+    _write_dead_round(tmp_path, 4)
+    # file form, with comments
+    (tmp_path / "BENCH_ACK").write_text(
+        "# known-dead artifacts\nBENCH_r04  # driver timeout, fixed\n"
+    )
+    rows, flags = benchtrend.analyze(str(tmp_path), threshold=0.4, min_prior=2)
+    assert flags == [], flags
+    row = next(r for r in rows if r["metric"] == "artifact_health")
+    assert row["verdict"] == "acked" and "BENCH_r04" in str(row["latest"])
+    # a NEW dead round still flags despite the old ack
+    _write_dead_round(tmp_path, 5)
+    _rows, flags = benchtrend.analyze(str(tmp_path), threshold=0.4, min_prior=2)
+    assert any("BENCH_r05" in f for f in flags), flags
+    # --ack covers it without touching the file (and exits 0 strictly)
+    _rows, flags = benchtrend.analyze(
+        str(tmp_path), threshold=0.4, min_prior=2, acks=("BENCH_r05",)
+    )
+    assert flags == [], flags
+    strict = subprocess.run(
+        [
+            sys.executable,
+            str(REPO / "scripts" / "benchtrend.py"),
+            "--dir", str(tmp_path),
+            "--ack", "BENCH_r05",
+        ],
+        capture_output=True,
+        text=True,
+    )
+    assert strict.returncode == 0, strict.stdout
+    assert "acked" in strict.stdout
+
+
+def test_committed_tree_is_strict_green(tmp_path):
+    """check.sh now runs benchtrend WITHOUT --report-only: the committed
+    artifacts + BENCH_ACK must be strict-green or the gate is red on
+    arrival (r05 is acked in the committed BENCH_ACK)."""
+    real = subprocess.run(
+        [sys.executable, str(REPO / "scripts" / "benchtrend.py")],
+        capture_output=True,
+        text=True,
+    )
+    assert real.returncode == 0, real.stdout
+
+
+def test_acked_multichip_round_does_not_flag(tmp_path):
+    _write_round(tmp_path, 1, {"engine_cpu_blocks_per_sec": 1.0})
+    (tmp_path / "MULTICHIP_r01.json").write_text(
+        json.dumps({"n_devices": 8, "rc": 0, "ok": True, "skipped": False})
+    )
+    (tmp_path / "MULTICHIP_r02.json").write_text(
+        json.dumps({"n_devices": 8, "rc": 124, "ok": False, "skipped": False})
+    )
+    (tmp_path / "BENCH_ACK").write_text("MULTICHIP_r02\n")
+    rows, flags = benchtrend.analyze(str(tmp_path), threshold=0.4, min_prior=2)
+    assert flags == [], flags
+    row = next(r for r in rows if r["metric"] == "multichip_ok")
+    assert row["verdict"] == "ok"
+
+
 def test_multichip_health_row(tmp_path):
     _write_round(tmp_path, 1, {"engine_cpu_blocks_per_sec": 1.0})
     (tmp_path / "MULTICHIP_r01.json").write_text(

@@ -255,11 +255,16 @@ def test_crash_dump_contains_crashing_batch_trace(tmp_path, monkeypatch):
     assert len(dumps) == 1, os.listdir(tmp_path)
     d = json.load(open(os.path.join(tmp_path, dumps[0])))
     crash = [r for r in d["records"] if r["kind"] == "sched.executor_crash"]
-    assert crash and "engine exploded" in crash[0]["error"]
-    assert crash[0]["crashed_trace_ids"] == ["cc" * 8]
+    # [-1]: the process-global ring may hold crash records from earlier
+    # tests in the same run — THIS scheduler's crash is the newest one
+    assert crash and "engine exploded" in crash[-1]["error"]
+    assert crash[-1]["crashed_trace_ids"] == ["cc" * 8]
+    # the inline engine dispatch is the stage that died (depth 1 fuses
+    # pack/dispatch/resolve into the executor's engine round-trip)
+    assert crash[-1]["stage"] == "dispatch"
     starts = [r for r in d["records"] if r["kind"] == "sched.batch_start"]
     assert starts and starts[-1]["trace_ids"] == ["cc" * 8]
-    assert starts[-1]["batch_id"] == crash[0]["batch_id"]
+    assert starts[-1]["batch_id"] == crash[-1]["batch_id"]
 
 
 def test_debug_flight_endpoint_and_healthz_flip_dump(tmp_path, monkeypatch):

@@ -562,6 +562,129 @@ def test_spanname_mutation_uncataloged_span_fails_cli(tmp_path, monkeypatch):
 
 
 # ---------------------------------------------------------------------------
+# JNPHOSTLOOP
+# ---------------------------------------------------------------------------
+
+JNP_LOOP_SRC = '''
+import jax
+import jax.numpy as jnp
+
+@jax.jit
+def kernel(x):
+    out = helper(x)
+    for _ in range(4):
+        out = jnp.sin(out)  # traced: the loop unrolls at trace time
+    return out
+
+def helper(x):
+    out = x
+    for _ in range(3):
+        out = jnp.add(out, 1)  # exempt: reachable from the jitted kernel
+    return out
+
+def hot_loop(items):
+    out = []
+    for it in items:
+        out.append(jnp.asarray(it))
+    return out
+
+def busy_wait(ready, x):
+    while not ready():
+        x = jnp.abs(x)
+    return x
+
+def fine(items):
+    arr = jnp.asarray(items)  # no loop around it
+    total = 0
+    for it in items:
+        total += len(it)  # loop without jnp
+    return arr, total
+
+def iter_expr_runs_once(x, n):
+    out = []
+    for row in jnp.split(x, n):  # the iterable evaluates ONCE: fine
+        out.append(len(row))
+    else:
+        out.append(jnp.size(x))  # else clause runs once too: fine
+    return out
+
+def comp_loop(items):
+    return [jnp.asarray(it) for it in items]  # per-element dispatch
+
+def comp_iter_once(x, n):
+    return [len(row) for row in jnp.split(x, n)]  # iterable once: fine
+
+def annotated(items):
+    out = []
+    for it in items:
+        out.append(jnp.asarray(it))  # phantlint: disable=JNPHOSTLOOP — deliberate per-iteration probe
+    return out
+'''
+
+
+def test_jnphostloop_flags_host_loops_only(tmp_path, monkeypatch):
+    from phant_tpu.analysis.rules.jnphostloop import JnpHostLoopRule
+
+    res = run_fixture(
+        tmp_path, monkeypatch, {"loops.py": JNP_LOOP_SRC}, [JnpHostLoopRule()]
+    )
+    ctxs = sorted(f.context for f in res.new)
+    assert ctxs == [
+        "pkg.loops.busy_wait",
+        "pkg.loops.comp_loop",
+        "pkg.loops.hot_loop",
+    ], [f.render() for f in res.new]
+    msgs = {f.context: f.message for f in res.new}
+    assert "for loop" in msgs["pkg.loops.hot_loop"]
+    assert "while loop" in msgs["pkg.loops.busy_wait"]
+    assert "comprehension loop" in msgs["pkg.loops.comp_loop"]
+    # jitted function, jit-reachable helper, loop-free call, loop without
+    # jnp: all quiet; the annotated loop is suppressed (counted, not new)
+    assert res.suppressed >= 1
+
+
+def test_jnphostloop_resolves_from_jax_import_alias(tmp_path, monkeypatch):
+    from phant_tpu.analysis.rules.jnphostloop import JnpHostLoopRule
+
+    src = '''
+from jax import numpy as jn
+
+def spin(items):
+    out = []
+    for it in items:
+        out.append(jn.asarray(it))
+    return out
+'''
+    res = run_fixture(
+        tmp_path, monkeypatch, {"alias.py": src}, [JnpHostLoopRule()]
+    )
+    assert len(res.new) == 1 and "jn.asarray" in res.new[0].message
+
+
+def test_jnp_in_host_loop_mutation_turns_gate_red(mutated_tree, monkeypatch):
+    """Acceptance mutation: introducing a per-iteration jnp call into a
+    host loop on the pipeline path makes the gate red with a JNPHOSTLOOP
+    finding at the loop's call site."""
+    p = mutated_tree / "phant_tpu" / "ops" / "witness_engine.py"
+    src = p.read_text()
+    mutated = src.replace(
+        "            for b, (_root, nodes) in enumerate(witnesses):\n"
+        "                counts[b] = len(nodes)\n",
+        "            import jax.numpy as jnp\n"
+        "            for b, (_root, nodes) in enumerate(witnesses):\n"
+        "                counts[b] = jnp.asarray(len(nodes))\n",
+        1,
+    )
+    assert mutated != src
+    p.write_text(mutated)
+    res = _analyze_repo_tree(mutated_tree, monkeypatch)
+    hits = [f for f in res.new if f.rule == "JNPHOSTLOOP"]
+    assert hits, [f.render() for f in res.new]
+    assert "witness_engine" in hits[0].path
+    assert "jnp.asarray" in hits[0].message
+
+
+# ---------------------------------------------------------------------------
 # baseline round trip
 # ---------------------------------------------------------------------------
 

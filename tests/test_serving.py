@@ -642,3 +642,342 @@ def test_cli_scheduler_flags():
     assert args.sched_max_batch == 32
     assert args.sched_max_wait_ms == 2.5
     assert args.sched_queue_depth == 64
+
+
+# ---------------------------------------------------------------------------
+# pipelined execution (pipeline_depth >= 2) — PR 5
+# ---------------------------------------------------------------------------
+
+
+class _WrappedEngine:
+    """Real WitnessEngine behind a veneer the tests can instrument."""
+
+    def __init__(self):
+        self.eng = WitnessEngine()
+        self.inflight = 0
+
+    def verify_batch(self, w):
+        return self.eng.verify_batch(w)
+
+    def begin_batch(self, w):
+        self.inflight += 1
+        return self.eng.begin_batch(w)
+
+    def resolve_batch(self, h):
+        out = self.eng.resolve_batch(h)
+        self.inflight -= 1
+        return out
+
+    def abandon_batch(self, h):
+        # part of the two-phase contract: a scheduler dying with this
+        # handle in flight releases the engine lease through here
+        self.eng.abandon_batch(h)
+        self.inflight -= 1
+
+    def stats_snapshot(self):
+        return self.eng.stats_snapshot()
+
+
+class _PoisonedResolveEngine(_WrappedEngine):
+    """Healthy until ARMED, then resolve dies — the wedged-device readback
+    failure mode, landing on the resolve worker. Arming after the healthy
+    futures complete keeps the test immune to how many batches the
+    assembler happened to form for them."""
+
+    def __init__(self):
+        super().__init__()
+        self.armed = False
+
+    def resolve_batch(self, h):
+        if self.armed:
+            raise RuntimeError("resolve stage poisoned")
+        return super().resolve_batch(h)
+
+
+class _PoisonedBeginEngine(_WrappedEngine):
+    def begin_batch(self, w):
+        raise RuntimeError("pack stage poisoned")
+
+
+def test_pipeline_depth2_byte_identical_under_concurrent_submitters():
+    """The acceptance ordering criterion: results at depth 2 under
+    concurrent submitters are byte-identical (per request) to depth-1
+    execution of the same witnesses."""
+    wits = _witness_set(96)
+    direct = WitnessEngine().verify_batch(wits)
+    for depth in (1, 2):
+        s = _sched(
+            max_batch=16, max_wait_ms=20.0, queue_depth=4096,
+            pipeline_depth=depth,
+        )
+        try:
+            results = [None] * len(wits)
+
+            def go(i):
+                results[i] = s.submit_witness(*wits[i]).result(timeout=30)
+
+            threads = [
+                threading.Thread(target=go, args=(i,))
+                for i in range(len(wits))
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            st = s.stats_snapshot()
+        finally:
+            s.shutdown()
+        assert [bool(r) for r in results] == [bool(v) for v in direct]
+        if depth == 2:
+            assert st["pipelined_batches"] >= 1, st
+        else:
+            assert st["pipelined_batches"] == 0, st
+
+
+def test_pipeline_verify_many_matches_depth1_with_bad_witnesses():
+    wits = _witness_set(48)
+    bad = list(wits)
+    bad[5] = (bad[5][0], bad[5][1] + [b"\x01" * 40])
+    bad[11] = (bad[11][0], [])
+    direct = WitnessEngine().verify_batch(bad)
+    with _sched(max_batch=8, max_wait_ms=10.0, queue_depth=4096,
+                pipeline_depth=2) as s:
+        out = s.verify_many(bad)
+    assert (out == direct).all()
+
+
+def test_pipeline_poisoned_resolve_fails_only_inflight():
+    """A resolve-stage crash at depth 2: already-resolved batches keep
+    their VALID verdicts, the in-flight handles fail fast with the
+    -32052 SchedulerDown code, and the crash flight record names the
+    resolve stage."""
+    from phant_tpu.obs.flight import flight
+
+    wits = _witness_set(8)
+    eng = _PoisonedResolveEngine()
+    s = VerificationScheduler(
+        engine=eng,
+        config=SchedulerConfig(
+            max_batch=4, max_wait_ms=5.0, pipeline_depth=2
+        ),
+    )
+    try:
+        first = [s.submit_witness(*w) for w in wits[:4]]
+        assert all(f.result(timeout=30) for f in first)  # resolved, VALID
+        eng.armed = True
+        second = [s.submit_witness(*w) for w in wits[4:]]
+        downs = []
+        for f in second:
+            with pytest.raises(SchedulerDown) as ei:
+                f.result(timeout=30)
+            downs.append(ei.value)
+        assert all(d.code == -32052 for d in downs)
+        # the already-resolved futures still read VALID after the crash
+        assert all(f.result(timeout=1) for f in first)
+        assert s.state()["executor_alive"] is False
+        crash = [
+            r for r in flight.records()
+            if r.get("kind") == "sched.executor_crash"
+        ][-1]
+        assert crash.get("stage") == "resolve", crash
+        assert "resolve stage poisoned" in crash.get("error", "")
+    finally:
+        s.shutdown()
+    # the crash must not leak engine leases: a wedged in-flight count on
+    # the (shared) engine would defer generation flushes forever
+    assert eng.eng._inflight == 0
+    assert eng.eng.verify_batch(wits[:2]).all()  # engine still serves
+
+
+def test_pipeline_poisoned_pack_names_pack_stage():
+    from phant_tpu.obs.flight import flight
+
+    wits = _witness_set(2)
+    s = VerificationScheduler(
+        engine=_PoisonedBeginEngine(),
+        config=SchedulerConfig(max_batch=4, max_wait_ms=2.0, pipeline_depth=2),
+    )
+    try:
+        with pytest.raises(SchedulerDown):
+            s.submit_witness(*wits[0]).result(timeout=30)
+        crash = [
+            r for r in flight.records()
+            if r.get("kind") == "sched.executor_crash"
+        ][-1]
+        assert crash.get("stage") == "pack", crash
+    finally:
+        s.shutdown()
+
+
+def test_pipeline_shutdown_drains_queue_and_inflight_handles():
+    wits = _witness_set(64)
+    s = _sched(max_batch=8, max_wait_ms=1.0, queue_depth=256,
+               pipeline_depth=3)
+    futs = [s.submit_witness(*w) for w in wits]
+    s.shutdown(drain=True)
+    assert all(f.result(timeout=1) for f in futs)  # all already resolved
+    with pytest.raises(SchedulerDown):
+        s.submit_witness(*wits[0])
+
+
+def test_pipeline_serial_lane_drains_inflight_first():
+    """Serial exclusivity extends to the pipeline: when the serial job
+    runs, no witness handle is between begin and resolve."""
+    wits = _witness_set(32)
+    eng = _WrappedEngine()
+    s = VerificationScheduler(
+        engine=eng,
+        config=SchedulerConfig(
+            max_batch=4, max_wait_ms=5.0, queue_depth=4096, pipeline_depth=2
+        ),
+    )
+    try:
+        futs = [s.submit_witness(*w) for w in wits]
+        seen = []
+        serial = s.submit_serial(lambda: seen.append(eng.inflight) or 42)
+        assert serial.result(timeout=30) == 42
+        assert all(f.result(timeout=30) for f in futs)
+        assert seen == [0], seen  # zero handles in flight during mutation
+    finally:
+        s.shutdown()
+
+
+def test_pipeline_depth1_runs_without_resolve_worker():
+    with _sched(max_batch=4, max_wait_ms=1.0, pipeline_depth=1) as s:
+        assert s._resolve_thread is None
+        wits = _witness_set(4)
+        assert s.verify_many(wits).all()
+        st = s.stats_snapshot()
+        assert st["pipelined_batches"] == 0
+        assert st["pipeline_depth"] == 1
+    # depth comes from the env default when unset (check.sh pins it)
+    assert SchedulerConfig().pipeline_depth >= 1
+
+
+def test_pipeline_batch_records_carry_stage():
+    from phant_tpu.obs.flight import flight
+
+    wits = _witness_set(6)
+    with _sched(max_batch=8, max_wait_ms=5.0, pipeline_depth=2) as s:
+        assert s.verify_many(wits).all()
+        recs = flight.records()
+    starts = [r for r in recs if r.get("kind") == "sched.batch_start"]
+    dones = [r for r in recs if r.get("kind") == "sched.batch_done"]
+    assert any(r.get("stage") == "pack" for r in starts), starts[-3:]
+    piped = [r for r in dones if r.get("stage") == "resolve"]
+    assert piped, dones[-3:]
+    assert "pack_ms" in piped[-1] and "resolve_ms" in piped[-1]
+
+
+def test_cli_pipeline_depth_flag():
+    args = build_parser().parse_args([])
+    assert args.sched_pipeline_depth is None  # env/2 default applies
+    args = build_parser().parse_args(["--sched-pipeline-depth", "3"])
+    assert args.sched_pipeline_depth == 3
+
+
+def test_two_pipelined_schedulers_share_one_engine():
+    """Two schedulers over the process-shared engine interleave their
+    begin/resolve sequences arbitrarily — the engine accepts any order,
+    so neither scheduler may spuriously die."""
+    wits = _witness_set(64)
+    direct = WitnessEngine().verify_batch(wits)
+    eng = WitnessEngine()
+    s1 = _sched(engine=eng, max_batch=8, max_wait_ms=5.0, queue_depth=4096,
+                pipeline_depth=2)
+    s2 = _sched(engine=eng, max_batch=8, max_wait_ms=5.0, queue_depth=4096,
+                pipeline_depth=2)
+    try:
+        outs = {}
+
+        def run(name, sched, span):
+            outs[name] = sched.verify_many(span)
+
+        t1 = threading.Thread(target=run, args=("a", s1, wits[:32]))
+        t2 = threading.Thread(target=run, args=("b", s2, wits[32:]))
+        t1.start(); t2.start(); t1.join(60); t2.join(60)
+        assert (outs["a"] == direct[:32]).all()
+        assert (outs["b"] == direct[32:]).all()
+        assert s1.state()["executor_alive"] and s2.state()["executor_alive"]
+        assert eng._inflight == 0
+    finally:
+        s1.shutdown()
+        s2.shutdown()
+
+
+def test_serial_job_does_not_run_on_dead_scheduler():
+    """A state mutation queued behind a witness crash must FAIL, not
+    execute: /healthz says 503, so committing a mutation there would be a
+    lie (the pre-fix drain returned early on death and ran it anyway)."""
+    eng = _PoisonedResolveEngine()
+    eng.armed = True  # first resolve crashes
+    s = VerificationScheduler(
+        engine=eng,
+        config=SchedulerConfig(max_batch=4, max_wait_ms=2.0, pipeline_depth=2),
+    )
+    try:
+        wits = _witness_set(2)
+        fut_w = s.submit_witness(*wits[0])
+        ran = []
+        fut_s = s.submit_serial(lambda: ran.append(1) or 7)
+        with pytest.raises(SchedulerDown):
+            fut_w.result(timeout=30)
+        with pytest.raises(SchedulerDown):
+            fut_s.result(timeout=30)
+        assert ran == []  # the mutation never executed
+    finally:
+        s.shutdown()
+
+
+def test_pipeline_sheds_jobs_expiring_during_slot_wait():
+    """A wedged/slow resolve stage holds the pipeline full; a job whose
+    deadline passes while the executor waits for a slot must shed with
+    DeadlineExpired instead of executing long after its waiter gave up."""
+    class _SlowResolve(_WrappedEngine):
+        def resolve_batch(self, h):
+            time.sleep(0.4)
+            return super().resolve_batch(h)
+
+    s = VerificationScheduler(
+        engine=_SlowResolve(),
+        config=SchedulerConfig(
+            max_batch=1, max_wait_ms=1.0, queue_depth=64,
+            pipeline_depth=2, deadline_ms=150.0,
+        ),
+    )
+    try:
+        wits = _witness_set(4)
+        futs = [s.submit_witness(*w) for w in wits]
+        outcomes = []
+        for f in futs:
+            try:
+                outcomes.append(bool(f.result(timeout=30)))
+            except DeadlineExpired:
+                outcomes.append("expired")
+        assert "expired" in outcomes, outcomes
+        assert True in outcomes, outcomes  # the head of the line still ran
+        assert s.state()["executor_alive"] is True
+    finally:
+        s.shutdown()
+
+
+def test_pipelined_meta_cache_misses_match_inline_semantics():
+    """cache_misses in the batch record = UNIQUE novel nodes hashed, at
+    every depth — a within-batch duplicate node must not read as an extra
+    miss only when the pipeline is on."""
+    root, nodes = _witness_set(1)[0]
+    dup_nodes = list(nodes) + [nodes[0]]  # one duplicated node
+    metas = {}
+    for depth in (1, 2):
+        s = _sched(max_batch=4, max_wait_ms=2.0, pipeline_depth=depth)
+        try:
+            ok, meta = s.verify_traced(root, dup_nodes)
+            assert ok
+            metas[depth] = meta
+        finally:
+            s.shutdown()
+    assert (
+        metas[1]["cache_misses"]
+        == metas[2]["cache_misses"]
+        == len(set(dup_nodes))
+    ), metas
