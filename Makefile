@@ -1,5 +1,5 @@
 # Commit gate (VERDICT r2 #4): `make check` must be green before a snapshot.
-.PHONY: check check-fast check-device native sanitize metrics-lint lint soak trend
+.PHONY: check check-fast check-device native sanitize metrics-lint lint soak trend loadgen
 
 check:
 	./scripts/check.sh
@@ -50,9 +50,19 @@ sanitize:
 # exactly once, coalesce witness batches, shed nothing, and drain clean.
 # It then induces ONE executor crash in a throwaway server and asserts the
 # obs flight recorder wrote a well-formed postmortem dump (build/flight/)
-# that names the crashing batch and its request trace ids.
+# that names the crashing batch and its request trace ids, and finishes
+# with a <=60s fixed-seed scripts/loadgen.py overload sweep asserting the
+# QoS contract: zero serial-lane sheds, nonzero adaptive-wait
+# adjustments, no tenant starvation, slow-loris connections reaped.
 soak:
 	JAX_PLATFORMS=cpu python scripts/soak.py
+
+# Open-loop serving load harness (minutes; the bench `serving_load`
+# section runs the same profile): Poisson arrivals + bursts + slow-loris
+# against a real EngineAPIServer, saturation curve + p50/p99/p999 +
+# per-tenant fairness verdicts. See README "Serving: QoS".
+loadgen:
+	JAX_PLATFORMS=cpu python scripts/loadgen.py --duration 30
 
 # Regression sentinel over the committed BENCH_r*/MULTICHIP_r* artifacts:
 # aligns every section metric across rounds and flags a latest-round value

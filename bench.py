@@ -54,6 +54,14 @@ root. Verifiers measured on the SAME span:
     with, plus the mean assembled batch size; sched_depth1/sched_depth2
     are the native-route pipeline-depth parity pair (the CPU path is
     intern-table bound, so depth 2 must track depth 1).
+  * serving_load (CPU section) — the QoS acceptance harness
+    (scripts/loadgen.py): an OPEN-LOOP Poisson generator with bursts, a
+    10:1 backfill:head tenant mix, and slow-loris clients against a real
+    EngineAPIServer on an ephemeral port; emits the saturation curve
+    (throughput vs offered load at 3 points), p50/p99/p999 latency,
+    head-of-chain p99 under overload, shed rate, and the server-side
+    no-starvation / zero-serial-shed / adaptive-wait verdicts
+    (serving_load_* keys; scripts/benchtrend.py knows their directions).
   * engine_pipeline (device section) — the PR 5 tentpole's A/B: the
     device-routed engine through the scheduler at pipeline depth 1 vs 2
     (pack of batch N+1 overlapping device compute + digest resolve of
@@ -1554,6 +1562,34 @@ def sec_replay_cpu() -> dict:
     return _replay_variants("cpu")
 
 
+def sec_serving_load() -> dict:
+    """Open-loop serving saturation sweep (scripts/loadgen.py): Poisson
+    arrivals with bursts, a 10:1 backfill:head tenant mix, and slow-loris
+    clients against a REAL EngineAPIServer on an ephemeral port — the
+    QoS acceptance artifact. Emits the saturation curve (throughput vs
+    offered load at 3 points around a measured capacity estimate),
+    p50/p99/p999 latency at the nominal point, head-of-chain p99 under
+    overload, shed rate, and the no-starvation / zero-serial-shed /
+    adaptive-wait verdicts from the server's own flight recorder.
+    PHANT_BENCH_LOADGEN_SECONDS sizes each load point (default 30)."""
+    scripts_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)), "scripts")
+    if scripts_dir not in sys.path:
+        sys.path.insert(0, scripts_dir)
+    import loadgen
+
+    seconds = float(os.environ.get("PHANT_BENCH_LOADGEN_SECONDS", "30"))
+    result = loadgen.run_profile(
+        seed=6,
+        duration_s=seconds,
+        multipliers=(0.5, 1.0, 2.0),
+        slow_loris=2,
+        log=lambda msg: _log(f"serving_load: {msg}"),
+    )
+    out = loadgen.bench_keys(result)
+    out["serving_load_checks"] = result.get("checks")
+    return out
+
+
 def sec_engine_pipeline() -> dict:
     """Pipelined witness execution A/B (the PR 5 tentpole): the same span
     through the serving scheduler at pipeline depth 1 (serialized pack ->
@@ -1667,6 +1703,7 @@ def sec_replay_device() -> dict:
 # engine number and the GLV proof come first
 _CPU_SECTIONS = {
     "engine": sec_engine_cpu,
+    "serving_load": sec_serving_load,
     "replay": sec_replay_cpu,
     "state_root": sec_state_root_cpu,
     "ecrecover": sec_ecrecover_cpu,

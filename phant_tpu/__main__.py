@@ -118,6 +118,48 @@ def build_parser() -> argparse.ArgumentParser:
         "digest resolve of batch N; 1 serializes (the pre-pipeline "
         "behavior). Default: PHANT_SCHED_PIPELINE_DEPTH or 2",
     )
+    # multi-tenant QoS (phant_tpu/serving/qos.py): per-tenant lanes,
+    # quotas, weighted fair dequeue, and the adaptive batching wait
+    p.add_argument(
+        "--sched-tenant-quota",
+        type=int,
+        default=None,
+        help="Max queued witness requests PER TENANT lane (X-Phant-Tenant "
+        "header); 0 = only the global queue depth bounds a lane. "
+        "Default: PHANT_SCHED_TENANT_QUOTA or 0",
+    )
+    p.add_argument(
+        "--sched-tenant-weights",
+        type=str,
+        default=None,
+        help="Weighted-fair dequeue shares as name:weight,... (e.g. "
+        "'cl:4,indexer:1'); unlisted tenants weigh 1. Default: "
+        "PHANT_SCHED_TENANT_WEIGHTS",
+    )
+    p.add_argument(
+        "--sched-adaptive-wait",
+        type=int,
+        choices=(0, 1),
+        default=None,
+        help="1 = shrink the batch-assembly wait as the queue deepens and "
+        "widen it when idle (the inference-serving policy); 0 = static "
+        "--sched-max-wait-ms. Default: PHANT_SCHED_ADAPTIVE_WAIT or 1",
+    )
+    p.add_argument(
+        "--sched-min-wait-ms",
+        type=float,
+        default=None,
+        help="Adaptive-wait floor once the queue holds ~one full batch. "
+        "Default: PHANT_SCHED_MIN_WAIT_MS or 0.2",
+    )
+    p.add_argument(
+        "--http-timeout-s",
+        type=float,
+        default=None,
+        help="Socket read/write deadline per Engine API connection; a "
+        "stalled (slow-loris) client frees its handler thread after this "
+        "long. <=0 disables. Default: PHANT_HTTP_TIMEOUT_S or 30",
+    )
     return p
 
 
@@ -162,7 +204,7 @@ def main(argv=None) -> int:
         config=config,
     )
 
-    from phant_tpu.serving import SchedulerConfig
+    from phant_tpu.serving import SchedulerConfig, parse_weights
 
     sched_kwargs = dict(
         max_batch=args.sched_max_batch,
@@ -171,6 +213,20 @@ def main(argv=None) -> int:
     )
     if args.sched_pipeline_depth is not None:
         sched_kwargs["pipeline_depth"] = args.sched_pipeline_depth
+    # QoS knobs: a flag wins over its PHANT_SCHED_* env default
+    if args.sched_tenant_quota is not None:
+        sched_kwargs["tenant_quota"] = args.sched_tenant_quota
+    if args.sched_tenant_weights is not None:
+        sched_kwargs["tenant_weights"] = parse_weights(args.sched_tenant_weights)
+    if args.sched_adaptive_wait is not None:
+        sched_kwargs["adaptive_wait"] = bool(args.sched_adaptive_wait)
+    if args.sched_min_wait_ms is not None:
+        sched_kwargs["min_wait_ms"] = args.sched_min_wait_ms
+    if args.http_timeout_s is not None:
+        # the handler reads the env per accepted connection
+        import os
+
+        os.environ["PHANT_HTTP_TIMEOUT_S"] = str(args.http_timeout_s)
     sched_config = SchedulerConfig(**sched_kwargs)
     server = EngineAPIServer(
         chain,

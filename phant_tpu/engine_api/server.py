@@ -16,9 +16,17 @@ Request execution goes through the continuous-batching scheduler
   verification coalesces with other in-flight requests into one
   engine/device `verify_batch` dispatch via the scheduler's batch
   assembler (stateless.verify_witness_nodes);
-* scheduler rejections map to distinct JSON-RPC errors: queue full
-  -32050, deadline expired -32051, executor down -32052 — all HTTP 503,
-  counted under `sched.rejected{reason=...}`.
+* scheduler rejections map to distinct JSON-RPC errors: queue full /
+  tenant quota / evicted -32050, deadline expired -32051, executor down
+  -32052 — all HTTP 503, counted under `sched.rejected{reason=,tenant=}`;
+* multi-tenant QoS (phant_tpu/serving/qos.py): `X-Phant-Tenant` names the
+  per-client admission lane (quota + weighted fair dequeue) and
+  `X-Phant-Priority: head` marks head-of-chain work that preempts
+  backfill — state-mutating methods are always head class;
+* slow-loris tolerance: every accepted connection carries a socket
+  read/write deadline (PHANT_HTTP_TIMEOUT_S, default 30s) so a client
+  that stalls mid-headers, mid-body, or mid-read frees the handler
+  thread; the stall is counted in `engine_api.client_disconnects`.
 
 Observability surface: `GET /metrics` serves the process metrics registry
 as Prometheus text exposition, `GET /healthz` a JSON liveness probe that
@@ -38,6 +46,7 @@ from __future__ import annotations
 
 import json
 import logging
+import os
 import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -45,11 +54,17 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from phant_tpu.engine_api import handle_request
 from phant_tpu.obs import flight
 from phant_tpu.serving import (
+    PRIORITY_BACKFILL,
+    PRIORITY_HEAD,
     SchedulerConfig,
     SchedulerError,
     VerificationScheduler,
     active_scheduler,
+    current_priority,
+    current_tenant,
     install,
+    sanitize_tenant,
+    tenant_context,
     uninstall,
 )
 from phant_tpu.utils.trace import current_trace_id, metrics, trace_context
@@ -62,6 +77,64 @@ _START_MONOTONIC = time.monotonic()
 #: on the scheduler's executor (everything else is read-only or stateless
 #: and runs concurrently on the handler threads)
 _SERIAL_METHOD_PREFIXES = ("engine_newPayload", "engine_forkchoiceUpdated")
+
+
+def _http_timeout() -> float:
+    """Socket read/write deadline per accepted connection
+    (PHANT_HTTP_TIMEOUT_S, default 30; <=0 disables). A client that sends
+    headers and then stalls — the slow-loris shape scripts/loadgen.py
+    deliberately produces — must not pin a handler thread forever: the
+    deadline frees the thread and the stall is counted in
+    `engine_api.client_disconnects`. Read per connection so tests and the
+    load harness can tighten it without rebinding the server."""
+    return float(os.environ.get("PHANT_HTTP_TIMEOUT_S", "30"))
+
+
+class _StatelessGate:
+    """Bounded concurrency for `engine_executeStatelessPayloadV1`.
+
+    The scheduler bounds QUEUED witness verifications, but the rest of a
+    stateless execution (witness decode, EVM re-execution, root check)
+    runs on the handler thread — and ThreadingHTTPServer spawns one per
+    connection, so under open-loop overload the box accumulates hundreds
+    of half-done executions that thrash each other into multi-second p99s
+    while every one of them eventually "succeeds" (loadgen measured
+    exactly this before the gate existed). Graceful degradation means
+    refusing work the box cannot finish promptly: at most `limit`
+    stateless executions run at once; a request that cannot get a slot
+    within its class's patience sheds with the standard overload code
+    (-32050, `sched.rejected{reason=saturated, tenant=...}`).
+
+    Patience is the priority lever: backfill waits ~PHANT_HTTP_GATE_PATIENCE_S
+    (default 0.5s — overload must shed fast, not stack), head-of-chain
+    (`X-Phant-Priority: head`) waits 8x that before giving up. The serial
+    mutation lane never passes through this gate at all (shed order:
+    backfill first, never mutations)."""
+
+    def __init__(self, limit: int, patience_s: float):
+        self._sem = threading.Semaphore(limit) if limit > 0 else None
+        self.limit = limit
+        self.patience_s = patience_s
+
+    def acquire(self, head: bool) -> bool:
+        if self._sem is None:
+            return True
+        patience = self.patience_s * (8.0 if head else 1.0)
+        return self._sem.acquire(timeout=patience)
+
+    def release(self) -> None:
+        if self._sem is not None:
+            self._sem.release()
+
+
+def _default_gate() -> _StatelessGate:
+    limit = int(
+        os.environ.get(
+            "PHANT_HTTP_MAX_CONCURRENT", str(max(8, 4 * (os.cpu_count() or 2)))
+        )
+    )
+    patience = float(os.environ.get("PHANT_HTTP_GATE_PATIENCE_S", "0.5"))
+    return _StatelessGate(limit, patience)
 
 
 #: the scheduler instance whose death already triggered a healthz-503 dump
@@ -106,10 +179,32 @@ def _healthz_payload() -> tuple:
     return status, payload
 
 
+class _HTTPServer(ThreadingHTTPServer):
+    """ThreadingHTTPServer with a real listen backlog. The stdlib default
+    (request_queue_size=5) turns overload into multi-second connect waits
+    in the KERNEL accept queue — an invisible, unshed, unmeasured queue in
+    front of all the admission control this package builds. A deep backlog
+    moves the excess onto handler threads where the stateless gate and the
+    scheduler shed it with explicit -32050s within their patience window."""
+
+    request_queue_size = 256
+
+
 class _ObservableHandler(BaseHTTPRequestHandler):
     """Shared GET surface + disconnect-tolerant reply plumbing."""
 
     protocol_version = "HTTP/1.1"
+
+    def setup(self) -> None:
+        # socket read/write deadline BEFORE any rfile read: a stalled
+        # client (slow-loris headers, never-arriving body, wedged reader)
+        # raises TimeoutError out of the blocked call instead of pinning
+        # this handler thread for the life of the process. The stdlib's
+        # handle_one_request already closes the connection on that
+        # TimeoutError; the do_POST body read counts it first.
+        t = _http_timeout()
+        self.timeout = t if t > 0 else None
+        super().setup()
 
     def do_GET(self) -> None:  # noqa: N802 (stdlib API)
         path = self.path.split("?", 1)[0]
@@ -155,7 +250,10 @@ class _ObservableHandler(BaseHTTPRequestHandler):
                 self.send_header("X-Phant-Trace", tid)
             self.end_headers()
             self.wfile.write(raw)
-        except (BrokenPipeError, ConnectionResetError) as e:
+        except (BrokenPipeError, ConnectionResetError, TimeoutError) as e:
+            # TimeoutError: a client that stopped READING (full TCP buffer)
+            # is the write-side slow-loris; the socket deadline frees the
+            # thread and the disconnect counter covers both directions
             metrics.count("engine_api.client_disconnects")
             log.debug("client disconnected mid-reply: %r", e)
             # stop the keep-alive loop: reading the dead socket again would
@@ -192,6 +290,9 @@ class EngineAPIServer:
         if scheduler is None:
             scheduler = VerificationScheduler(config=sched_config)
         self.scheduler = scheduler
+        # graceful-degradation valve for stateless execution (env-sized at
+        # construction: PHANT_HTTP_MAX_CONCURRENT / PHANT_HTTP_GATE_PATIENCE_S)
+        self._gate = _default_gate()
         outer = self
 
         class Handler(_ObservableHandler):
@@ -211,8 +312,25 @@ class EngineAPIServer:
                 try:
                     # one trace context per request: the trace_id rides
                     # every span this thread opens and every scheduler job
-                    # it submits, and comes back in X-Phant-Trace
-                    with trace_context():
+                    # it submits, and comes back in X-Phant-Trace. The
+                    # tenant context (QoS lane + priority class,
+                    # serving/qos.py) rides the same thread-local channel:
+                    # X-Phant-Tenant names the admission lane (sanitized —
+                    # the header is attacker-controlled) and
+                    # X-Phant-Priority: head marks head-of-chain work
+                    # (state-mutating methods are always head class via
+                    # the serial lane, so the header only matters for
+                    # executeStateless).
+                    tenant = sanitize_tenant(
+                        self.headers.get("X-Phant-Tenant")
+                    )
+                    priority = (
+                        PRIORITY_HEAD
+                        if self.headers.get("X-Phant-Priority", "").lower()
+                        == "head"
+                        else PRIORITY_BACKFILL
+                    )
+                    with trace_context(), tenant_context(tenant, priority):
                         self._handle_post()
                 finally:
                     metrics.gauge_add("engine_api.inflight", -1)
@@ -222,7 +340,17 @@ class EngineAPIServer:
 
             def _handle_post(self) -> None:
                 length = int(self.headers.get("Content-Length", 0))
-                body = self.rfile.read(length)
+                try:
+                    body = self.rfile.read(length)
+                except TimeoutError:
+                    # slow-loris: headers arrived, the promised body never
+                    # did — the socket deadline freed this thread. Count
+                    # it with the other client disconnects and drop the
+                    # connection (a reply would race the dead read state).
+                    metrics.count("engine_api.client_disconnects")
+                    log.debug("client stalled mid-body; connection dropped")
+                    self.close_connection = True
+                    return
                 try:
                     request = json.loads(body)
                 except json.JSONDecodeError:
@@ -253,9 +381,52 @@ class EngineAPIServer:
                         status, response = outer.scheduler.submit_serial(
                             lambda: handle_request(outer.blockchain, request)
                         ).result()
+                    elif isinstance(method, str) and method.startswith(
+                        "engine_executeStateless"
+                    ):
+                        # concurrently on THIS handler thread, but behind
+                        # the bounded-concurrency gate: under overload the
+                        # box must shed backfill fast (head-of-chain gets
+                        # 8x the patience) instead of thrashing hundreds
+                        # of half-done EVM re-executions
+                        tenant = current_tenant()
+                        if not outer._gate.acquire(
+                            current_priority() == PRIORITY_HEAD
+                        ):
+                            metrics.count(
+                                "sched.rejected",
+                                reason="saturated",
+                                tenant=tenant,
+                            )
+                            flight.record(
+                                "sched.shed",
+                                reason="saturated",
+                                lane="stateless",
+                                tenant=tenant,
+                            )
+                            metrics.count("engine_api.request_errors")
+                            self._reply(
+                                503,
+                                {
+                                    "jsonrpc": "2.0",
+                                    "id": request.get("id"),
+                                    "error": {
+                                        "code": -32050,
+                                        "message": "node saturated: "
+                                        "stateless execution shed",
+                                    },
+                                },
+                            )
+                            return
+                        try:
+                            status, response = handle_request(
+                                outer.blockchain, request
+                            )
+                        finally:
+                            outer._gate.release()
                     else:
-                        # read-only / stateless: run concurrently on THIS
-                        # handler thread; witness verification inside
+                        # read-only: run concurrently on THIS handler
+                        # thread; any witness verification inside
                         # coalesces via the scheduler's batch assembler
                         status, response = handle_request(
                             outer.blockchain, request
@@ -278,7 +449,7 @@ class EngineAPIServer:
                 self._reply(status, response)
 
         try:
-            self._server = ThreadingHTTPServer((host, port), Handler)
+            self._server = _HTTPServer((host, port), Handler)
         except BaseException:
             # a bind failure must not leak the executor thread this
             # constructor just spawned (nobody else holds a reference)
@@ -323,7 +494,7 @@ class MetricsServer:
     live elsewhere — a separate bind keeps the two audiences separable."""
 
     def __init__(self, host: str = "127.0.0.1", port: int = 9465):
-        self._server = ThreadingHTTPServer((host, port), _ObservableHandler)
+        self._server = _HTTPServer((host, port), _ObservableHandler)
 
     @property
     def port(self) -> int:

@@ -22,6 +22,16 @@ from __future__ import annotations
 import threading
 from typing import Optional
 
+from phant_tpu.serving.qos import (
+    DEFAULT_TENANT,
+    PRIORITY_BACKFILL,
+    PRIORITY_HEAD,
+    current_priority,
+    current_tenant,
+    parse_weights,
+    sanitize_tenant,
+    tenant_context,
+)
 from phant_tpu.serving.scheduler import (
     DeadlineExpired,
     QueueFull,
@@ -32,6 +42,9 @@ from phant_tpu.serving.scheduler import (
 )
 
 __all__ = [
+    "DEFAULT_TENANT",
+    "PRIORITY_BACKFILL",
+    "PRIORITY_HEAD",
     "DeadlineExpired",
     "QueueFull",
     "SchedulerConfig",
@@ -39,7 +52,12 @@ __all__ = [
     "SchedulerError",
     "VerificationScheduler",
     "active_scheduler",
+    "current_priority",
+    "current_tenant",
     "install",
+    "parse_weights",
+    "sanitize_tenant",
+    "tenant_context",
     "uninstall",
 ]
 

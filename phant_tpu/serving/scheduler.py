@@ -9,25 +9,54 @@ shape instead:
 
     admission queue  ->  batch assembler  ->  single executor thread
 
-* **Admission queue** — bounded (`queue_depth`); a full queue REJECTS the
-  request with `QueueFull` (JSON-RPC `-32050`, counted in
-  `sched.rejected{reason=queue_full}`) instead of building unbounded
-  latency. Every request carries a deadline; a request whose deadline
-  passes while queued fails with `DeadlineExpired` (`-32051`) without
-  ever touching the engine.
-* **Batch assembler** — coalesces concurrent *witness-verification*
-  requests into shape buckets (bucket key = total witness bytes rounded
-  up to a power of two, the same rounding the device keccak path pads
-  its blob buffer to, ops/witness_jax._pow2ceil), so the padded device
-  buffers of one batch stay dense; `sched.padding_waste` reports the
-  unused fraction of the padded buffer the last batch would occupy.
-  Assembly runs under a `max_batch` / `max_wait_ms` policy: a batch
-  executes as soon as it is full, and an under-full batch waits at most
-  `max_wait_ms` from its head request's admission. Under load the
-  executor's busy period makes that wait moot (the backlog that formed
-  while the previous batch executed IS the next batch); the wait only
-  costs anything for a request arriving at an idle executor, which is
-  why it bounds — and is the whole of — the serial-client latency tax.
+* **Admission: per-tenant lanes + quotas (QoS, serving/qos.py)** — every
+  request carries a tenant tag (the Engine API server binds it from the
+  `X-Phant-Tenant` header via `tenant_context`; untagged submissions land
+  in the `default` lane) and a priority class. Witness jobs queue in a
+  per-tenant FIFO lane; the total across lanes is bounded by
+  `queue_depth` and each lane by `tenant_quota` (0 = unbounded), so one
+  backfill tenant can no longer fill the whole queue. A full lane sheds
+  with `QueueFull` (`-32050`, `sched.rejected{reason=tenant_quota,
+  tenant=...}`); a full queue sheds `reason=queue_full` — unless the
+  arriving job is head-of-chain (`PRIORITY_HEAD`: the serial mutation
+  lane, or a witness request marked `X-Phant-Priority: head`), in which
+  case a queued victim is evicted to make room (`reason=evicted`, same
+  `-32050` code). The shed order is fixed and documented: backfill
+  first (a head-class arrival at its tenant quota evicts its OWN
+  tenant's newest backfill; a full queue evicts the deepest lane's
+  newest backfill), head-class witness jobs only for an arriving SERIAL
+  mutation with no backfill left, and the serial mutation lane NEVER —
+  a mutation can only be rejected when the queue is full of OTHER
+  serial mutations (its own class's backlog). Eviction also never
+  touches `wait_for_space` (verify_many) jobs, whose contract is
+  completion. Every request still carries a deadline; expiry while
+  queued fails with `DeadlineExpired` (`-32051`) without touching the
+  engine.
+* **Dequeue: priority + weighted fairness** — the serial mutation lane
+  preempts all queued witness work (head-of-chain `newPayload` must not
+  sit behind a backfill burst); among witness lanes, lanes whose head is
+  `PRIORITY_HEAD` are served before backfill lanes, and the tenant is
+  chosen by smooth weighted round-robin (qos.WeightedFairPicker,
+  `tenant_weights`) so a 10:1 offered-load imbalance cannot starve the
+  light tenant — each lane stays FIFO internally.
+* **Batch assembler** — coalesces *witness-verification* requests into
+  shape buckets (bucket key = total witness bytes rounded up to a power
+  of two, the same rounding the device keccak path pads its blob buffer
+  to, ops/witness_jax._pow2ceil), so the padded device buffers of one
+  batch stay dense; same-bucket jobs coalesce ACROSS tenant lanes (the
+  engine dispatch is tenant-blind; fairness is enforced at head pick).
+  `sched.padding_waste` reports the unused fraction of the padded
+  buffer. Assembly runs under a `max_batch` / ADAPTIVE-wait policy
+  (qos.AdaptiveWait): a batch executes as soon as it is full, and an
+  under-full batch waits at most `wait_ms(queue_depth)` from its head
+  request's admission — the full `max_wait_ms` when the scheduler is
+  idle (a lone request gets its coalescing window), decaying to
+  `min_wait_ms` as the queue approaches one full batch, because then
+  the backlog IS the batch and further waiting is pure added latency.
+  The chosen wait is exported as the `sched.adaptive_wait_ms` gauge,
+  changes are counted in `sched.adaptive_wait_adjustments` and recorded
+  as `sched.adapt_wait` flight events; `adaptive_wait=False` pins the
+  static `max_wait_ms` policy (the pre-QoS behavior).
 * **Executor** — ONE thread drains buckets into the engine and resolves
   per-request futures. The same thread runs *serial* jobs
   (state-mutating `engine_newPayload*` execution) one at a time, in
@@ -106,6 +135,17 @@ import numpy as np
 
 from phant_tpu.obs.flight import flight
 from phant_tpu.obs.watchdog import Watchdog
+from phant_tpu.serving.qos import (
+    DEFAULT_TENANT,
+    OVERFLOW_TENANT,
+    PRIORITY_BACKFILL,
+    PRIORITY_HEAD,
+    AdaptiveWait,
+    WeightedFairPicker,
+    current_priority,
+    current_tenant,
+    parse_weights,
+)
 from phant_tpu.utils.trace import current_trace_id, metrics
 
 log = logging.getLogger("phant_tpu.serving")
@@ -144,18 +184,62 @@ def _default_pipeline_depth() -> int:
     return int(os.environ.get("PHANT_SCHED_PIPELINE_DEPTH", "2"))
 
 
+def _default_tenant_quota() -> int:
+    """PHANT_SCHED_TENANT_QUOTA: per-tenant queued-witness cap; 0 (the
+    default) means only the global queue_depth bounds a lane."""
+    return int(os.environ.get("PHANT_SCHED_TENANT_QUOTA", "0"))
+
+
+def _default_adaptive_wait() -> bool:
+    """PHANT_SCHED_ADAPTIVE_WAIT, default on: shrink the assembly wait as
+    the queue deepens, widen it when idle (qos.AdaptiveWait). 0 pins the
+    static max_wait_ms policy."""
+    return os.environ.get("PHANT_SCHED_ADAPTIVE_WAIT", "1") not in ("0", "")
+
+
+def _default_min_wait_ms() -> float:
+    """PHANT_SCHED_MIN_WAIT_MS: the adaptive-wait floor once the queue
+    holds a full batch (the backlog IS the batch)."""
+    return float(os.environ.get("PHANT_SCHED_MIN_WAIT_MS", "0.2"))
+
+
+def _default_tenant_weights() -> dict:
+    """PHANT_SCHED_TENANT_WEIGHTS (`name:weight,...`): weighted-fair
+    dequeue shares; unlisted tenants weigh 1."""
+    return parse_weights(os.environ.get("PHANT_SCHED_TENANT_WEIGHTS"))
+
+
+def _default_max_tenants() -> int:
+    """PHANT_SCHED_MAX_TENANTS: distinct tenant lanes tracked before new
+    tags fold into the shared OVERFLOW lane — an attacker spraying random
+    X-Phant-Tenant headers must not grow per-tenant state (or metric
+    cardinality) without bound."""
+    return int(os.environ.get("PHANT_SCHED_MAX_TENANTS", "64"))
+
+
 @dataclass
 class SchedulerConfig:
     """Knobs, surfaced as `--sched-*` CLI flags (phant_tpu/__main__.py)."""
 
     max_batch: int = 128  # requests per assembled witness batch
-    max_wait_ms: float = 5.0  # assembly wait for an under-full batch
+    max_wait_ms: float = 5.0  # assembly-wait ceiling for an under-full batch
     queue_depth: int = 512  # admission-queue bound (overload -> QueueFull)
     deadline_ms: float = 30_000.0  # default per-request deadline; <=0 = none
     # witness batches in flight between pack and resolve (>=2 pipelines:
     # the executor packs/dispatches batch N+1 while the resolve worker
     # reads back + joins batch N); 1 = today's serialized execution
     pipeline_depth: int = field(default_factory=_default_pipeline_depth)
+    # --- multi-tenant QoS (serving/qos.py) ---------------------------------
+    # per-tenant queued-witness cap (0 = global queue_depth only)
+    tenant_quota: int = field(default_factory=_default_tenant_quota)
+    # weighted-fair dequeue shares; unlisted tenants weigh 1.0
+    tenant_weights: dict = field(default_factory=_default_tenant_weights)
+    # queue-depth-adaptive assembly wait (False = static max_wait_ms)
+    adaptive_wait: bool = field(default_factory=_default_adaptive_wait)
+    # adaptive-wait floor (reached once the queue holds ~one full batch)
+    min_wait_ms: float = field(default_factory=_default_min_wait_ms)
+    # distinct tenant lanes before fold-over into OVERFLOW_TENANT
+    max_tenants: int = field(default_factory=_default_max_tenants)
 
 
 _WITNESS = "witness"
@@ -212,6 +296,14 @@ class _Job:
     future: Future
     admitted: float  # monotonic admission time
     deadline: Optional[float]  # monotonic expiry, None = no deadline
+    # QoS: the tenant lane this job queues in (folded through the
+    # max_tenants cap at admission) and its priority class. `sheddable`
+    # is False for wait_for_space admissions (verify_many): their
+    # contract is completion, so the eviction policy must never pick
+    # them as overload victims.
+    tenant: str = DEFAULT_TENANT
+    priority: int = PRIORITY_BACKFILL
+    sheddable: bool = True
     # witness lane
     root: bytes = b""
     nodes: Sequence[bytes] = ()
@@ -247,6 +339,20 @@ class VerificationScheduler:
         self._max_wait_s = self.config.max_wait_ms / 1e3
         self._queue_depth = self.config.queue_depth
         self._pipe_depth = max(1, self.config.pipeline_depth)
+        self._quota = max(0, self.config.tenant_quota)
+        self._max_tenants = max(1, self.config.max_tenants)
+        # QoS policy objects (serving/qos.py): both are only ever touched
+        # under _lock, so they need no locking of their own
+        self._picker = WeightedFairPicker(self.config.tenant_weights)
+        self._wait_policy: Optional[AdaptiveWait] = (
+            AdaptiveWait(
+                self.config.max_wait_ms,
+                min_wait_ms=self.config.min_wait_ms,
+                full_depth=self.config.max_batch,
+            )
+            if self.config.adaptive_wait
+            else None
+        )
         self._engine = engine
         # chaos drill (obs): PHANT_SCHED_CHAOS_CRASH=1 makes the FIRST
         # witness batch crash the executor — the supported way to fire-
@@ -257,7 +363,13 @@ class VerificationScheduler:
         self._chaos_crash = os.environ.get("PHANT_SCHED_CHAOS_CRASH") == "1"
         self._lock = threading.Lock()
         self._cond = threading.Condition(self._lock)
-        self._queue: List[_Job] = []
+        # admission state (guarded by _lock): the serial mutation lane is
+        # its own strict-FIFO queue (never shed by overload policy, only
+        # by deadline/death); witness jobs queue per tenant
+        self._serial_q: List[_Job] = []
+        self._lanes: dict = {}  # tenant -> List[_Job], FIFO per lane
+        self._tenant_stats: dict = {}  # tenant -> admitted/served/shed
+        self._last_wait_ms: Optional[float] = None  # adaptive-wait memo
         self._closed = False
         self._dead: Optional[BaseException] = None
         # observability: monotone batch ids + the in-flight descriptors the
@@ -280,6 +392,10 @@ class VerificationScheduler:
             "max_batch_seen": 0,
             "pipelined_batches": 0,
             "rejected": 0,
+            # QoS: backfill jobs evicted to admit head-of-chain work, and
+            # how often the adaptive policy changed the assembly wait
+            "evicted": 0,
+            "wait_adjustments": 0,
         }
         metrics.gauge_set("sched.pipeline_depth", self._pipe_depth)
         self._thread = threading.Thread(
@@ -309,6 +425,8 @@ class VerificationScheduler:
         root: bytes,
         nodes: Sequence[bytes],
         deadline_s: Optional[float],
+        tenant: Optional[str],
+        priority: Optional[int],
     ) -> _Job:
         nodes = list(nodes)
         nbytes = sum(map(len, nodes))
@@ -317,6 +435,11 @@ class VerificationScheduler:
             future=Future(),
             admitted=time.monotonic(),
             deadline=self._deadline(deadline_s),
+            # QoS identity: an explicit argument wins, otherwise the
+            # thread's tenant_context (the Engine API server binds one per
+            # request, qos.py) — offline callers land in DEFAULT_TENANT
+            tenant=tenant if tenant is not None else current_tenant(),
+            priority=priority if priority is not None else current_priority(),
             root=root,
             nodes=nodes,
             nbytes=nbytes,
@@ -330,12 +453,15 @@ class VerificationScheduler:
         nodes: Sequence[bytes],
         deadline_s: Optional[float] = None,
         wait_for_space: bool = False,
+        tenant: Optional[str] = None,
+        priority: Optional[int] = None,
     ) -> Future:
         """Queue one (root, nodes) linked-multiproof verification; the
         future resolves to the bool verdict. `wait_for_space` blocks on a
         full queue instead of rejecting (offline verify_many); the online
-        serving path never waits — overload must shed, not stack."""
-        job = self._witness_job(root, nodes, deadline_s)
+        serving path never waits — overload must shed, not stack.
+        `tenant`/`priority` default to the thread's tenant_context."""
+        job = self._witness_job(root, nodes, deadline_s, tenant, priority)
         self._admit(job, wait_for_space)
         return job.future
 
@@ -344,6 +470,8 @@ class VerificationScheduler:
         root: bytes,
         nodes: Sequence[bytes],
         deadline_s: Optional[float] = None,
+        tenant: Optional[str] = None,
+        priority: Optional[int] = None,
     ) -> Tuple[bool, Optional[dict]]:
         """One witness verification through the batching path, returning
         (verdict, batch record). The record — `batch_id`, `batch_size`,
@@ -351,23 +479,30 @@ class VerificationScheduler:
         is what joins the caller's span to the shared engine dispatch that
         served it (stateless.verify_witness_nodes folds it into the open
         `verify_block` span). Scheduler rejections raise as usual."""
-        job = self._witness_job(root, nodes, deadline_s)
+        job = self._witness_job(root, nodes, deadline_s, tenant, priority)
         self._admit(job, False)
         return bool(job.future.result()), job.meta
 
     def submit_serial(
-        self, fn: Callable, deadline_s: Optional[float] = None
+        self,
+        fn: Callable,
+        deadline_s: Optional[float] = None,
+        tenant: Optional[str] = None,
     ) -> Future:
         """Queue an exclusive job: the executor runs `fn()` with nothing
         else in flight — the replacement for the server's global execution
         lock (state-mutating newPayload execution). `fn`'s return value
         resolves the future; an exception from `fn` is request-scoped and
-        lands on the future (it does NOT kill the executor)."""
+        lands on the future (it does NOT kill the executor). Serial jobs
+        are always PRIORITY_HEAD: they preempt queued witness work and are
+        never shed to make room for anything."""
         job = _Job(
             kind=_SERIAL,
             future=Future(),
             admitted=time.monotonic(),
             deadline=self._deadline(deadline_s),
+            tenant=tenant if tenant is not None else current_tenant(),
+            priority=PRIORITY_HEAD,
             fn=fn,
             trace_id=current_trace_id(),
         )
@@ -383,9 +518,94 @@ class VerificationScheduler:
             return None
         return time.monotonic() + d
 
+    # -- QoS locked helpers --------------------------------------------------
+
+    def _lane_key_locked(self, tenant: str) -> str:
+        """Fold a tenant tag through the max_tenants cap: known tenants
+        keep their lane, new ones beyond the cap share OVERFLOW_TENANT
+        (bounded per-tenant state and metric cardinality under a
+        header-spraying client)."""
+        if tenant in self._tenant_stats or len(self._tenant_stats) < self._max_tenants:
+            return tenant
+        return OVERFLOW_TENANT
+
+    def _account_evicted_locked(self, victim: _Job, victims: List[_Job]) -> None:
+        """Stats for one eviction victim under the lock; the metric/flight
+        publishes and the future failure happen outside it (victims)."""
+        self.stats["rejected"] += 1
+        self.stats["evicted"] += 1
+        self._tenant_locked(victim.tenant)["shed"] += 1
+        victims.append(victim)
+
+    def _tenant_locked(self, tenant: str) -> dict:
+        st = self._tenant_stats.get(tenant)
+        if st is None:
+            st = self._tenant_stats[tenant] = {
+                "admitted": 0,
+                "served": 0,
+                "shed": 0,
+            }
+        return st
+
+    def _qlen_locked(self) -> int:
+        return len(self._serial_q) + self._wit_len_locked()
+
+    def _wit_len_locked(self) -> int:
+        # lanes are bounded by max_tenants (default 64): summing is O(1)-ish
+        return sum(len(lane) for lane in self._lanes.values())
+
+    def _enqueue_locked(self, job: _Job) -> None:
+        if job.kind == _SERIAL:
+            self._serial_q.append(job)
+        else:
+            self._lanes.setdefault(job.tenant, []).append(job)
+
+    @staticmethod
+    def _evict_from_lane_locked(
+        lane: List[_Job], allow_head: bool = False
+    ) -> Optional[_Job]:
+        """Newest sheddable backfill job of `lane` (newest head-class
+        witness job as a fallback when `allow_head`); wait_for_space
+        (verify_many) jobs are never victims — their contract is
+        completion, not load shedding."""
+        for want_backfill in (True, False) if allow_head else (True,):
+            for i in range(len(lane) - 1, -1, -1):
+                j = lane[i]
+                if not j.sheddable:
+                    continue
+                if (j.priority != PRIORITY_HEAD) == want_backfill:
+                    return lane.pop(i)
+        return None
+
+    def _evict_witness_locked(self, for_serial: bool) -> Optional[_Job]:
+        """Pick the load-shed victim that makes room for an arriving
+        head-of-chain job: the NEWEST backfill job of the DEEPEST lane —
+        backfill first (deepest lane first: the tenant most over its fair
+        share pays). When the arrival is a SERIAL mutation and every
+        queued witness job is head-class, the newest head-class witness
+        job is evicted instead: the serial lane outranks every witness
+        class and must only ever be shed by its OWN backlog. Never
+        evicts the serial lane, never a wait_for_space job. None when
+        nothing is sheddable."""
+        for allow_head in (False, True) if for_serial else (False,):
+            deepest = sorted(
+                (lane for lane in self._lanes.values() if lane),
+                key=len,
+                reverse=True,
+            )
+            for lane in deepest:
+                victim = self._evict_from_lane_locked(lane, allow_head=allow_head)
+                if victim is not None:
+                    return victim
+        return None
+
     def _admit(self, job: _Job, wait_for_space: bool) -> None:
         reason = None
+        victims: List[_Job] = []
+        lane_depth = None
+        job.sheddable = not wait_for_space
         with self._lock:
+            job.tenant = self._lane_key_locked(job.tenant)
             while True:
                 if self._dead is not None:
                     reason, err = "down", SchedulerDown(
@@ -397,30 +617,99 @@ class VerificationScheduler:
                         "scheduler is shutting down"
                     )
                     break
-                if len(self._queue) < self._queue_depth:
-                    self._queue.append(job)
-                    self.stats["requests"] += 1
-                    depth = len(self._queue)
-                    self._cond.notify_all()
+                if (
+                    job.kind == _WITNESS
+                    and self._quota
+                    and len(self._lanes.get(job.tenant, ())) >= self._quota
+                ):
+                    # the per-tenant cap sheds BEFORE the global bound: one
+                    # tenant's burst stays that tenant's problem. An
+                    # offline wait_for_space caller (verify_many) BLOCKS on
+                    # its quota exactly as it blocks on the global bound —
+                    # completion, not load shedding, is its contract — and
+                    # a HEAD-class arrival evicts its own tenant's newest
+                    # backfill job first: head work is only ever shed by
+                    # pressure from its own class
+                    if wait_for_space:
+                        self._cond.wait(0.05)
+                        continue
+                    if job.priority == PRIORITY_HEAD:
+                        v = self._evict_from_lane_locked(
+                            self._lanes[job.tenant]
+                        )
+                        if v is not None:
+                            self._account_evicted_locked(v, victims)
+                            continue  # lane has room now; re-run the checks
+                    reason, err = "tenant_quota", QueueFull(
+                        f"tenant {job.tenant!r} queue quota full ({self._quota})"
+                    )
                     break
-                if not wait_for_space:
+                if self._qlen_locked() < self._queue_depth:
+                    self._enqueue_locked(job)
+                elif job.priority == PRIORITY_HEAD and (
+                    v := self._evict_witness_locked(
+                        for_serial=job.kind == _SERIAL
+                    )
+                ) is not None:
+                    # global queue full but the arrival is head-of-chain:
+                    # shed the newest backfill job (for a serial mutation,
+                    # the newest head-class witness job as a fallback)
+                    # instead of the head work — the documented shed order;
+                    # same -32050 code, distinct reason so the postmortem
+                    # tells them apart
+                    self._account_evicted_locked(v, victims)
+                    self._enqueue_locked(job)
+                elif not wait_for_space:
                     reason, err = "queue_full", QueueFull(
                         f"admission queue full ({self._queue_depth})"
                     )
                     break
-                self._cond.wait(0.05)
+                else:
+                    self._cond.wait(0.05)
+                    continue
+                self.stats["requests"] += 1
+                self._tenant_locked(job.tenant)["admitted"] += 1
+                depth = self._qlen_locked()
+                if job.kind == _WITNESS:
+                    lane_depth = len(self._lanes[job.tenant])
+                self._cond.notify_all()
+                break
             if reason is not None:
                 self.stats["rejected"] += 1
-        if reason is not None:
-            metrics.count("sched.rejected", reason=reason)
+                self._tenant_locked(job.tenant)["shed"] += 1
+        for victim in victims:
+            metrics.count("sched.rejected", reason="evicted", tenant=victim.tenant)
+            metrics.count("sched.backfill_evictions", tenant=victim.tenant)
             flight.record(
-                "sched.shed", reason=reason, lane=job.kind, trace_id=job.trace_id
+                "sched.shed",
+                reason="evicted",
+                lane=victim.kind,
+                tenant=victim.tenant,
+                trace_id=victim.trace_id,
+            )
+            victim.future.set_exception(
+                QueueFull("evicted to admit head-of-chain work")
+            )
+        if reason is not None:
+            metrics.count("sched.rejected", reason=reason, tenant=job.tenant)
+            flight.record(
+                "sched.shed",
+                reason=reason,
+                lane=job.kind,
+                tenant=job.tenant,
+                trace_id=job.trace_id,
             )
             raise err
         metrics.gauge_set("sched.queue_depth", depth)
+        if lane_depth is not None:
+            metrics.gauge_set(
+                "sched.tenant_queue_depth", lane_depth, tenant=job.tenant
+            )
         flight.record(
             "sched.admit",
             lane=job.kind,
+            tenant=job.tenant,
+            priority=job.priority,
             bucket_bytes=job.bucket if job.kind == _WITNESS else None,
             queue_depth=depth,
             trace_id=job.trace_id,
@@ -466,7 +755,10 @@ class VerificationScheduler:
     def state(self) -> dict:
         """Liveness surface for `/healthz` (engine_api/server.py)."""
         with self._lock:
-            depth = len(self._queue)
+            depth = self._qlen_locked()
+            tenant_depths = {
+                t: len(lane) for t, lane in self._lanes.items() if lane
+            }
             dead = self._dead
             inflight = len(self._resolve_q) + (1 if self._resolving else 0)
         alive = dead is None and self._thread.is_alive()
@@ -476,9 +768,14 @@ class VerificationScheduler:
             alive = alive and self._resolve_thread.is_alive()
         out = {
             "queue_depth": depth,
+            "tenant_depths": tenant_depths,
             "executor_alive": alive,
             "max_batch": self.config.max_batch,
             "max_wait_ms": self.config.max_wait_ms,
+            # config echoes read off the immutable config, not the
+            # unpacked copies the locked regions use (lock-free surface)
+            "adaptive_wait": self.config.adaptive_wait,
+            "tenant_quota": self.config.tenant_quota,
             "pipeline_depth": self._pipe_depth,
             "pipeline_inflight": inflight,
         }
@@ -489,6 +786,9 @@ class VerificationScheduler:
     def stats_snapshot(self) -> dict:
         with self._lock:
             st = dict(self.stats)
+            st["tenants"] = {
+                t: dict(ts) for t, ts in self._tenant_stats.items()
+            }
         b = st["batches"]
         st["mean_batch"] = round(st["batched_requests"] / b, 2) if b else 0.0
         st["pipeline_depth"] = self._pipe_depth
@@ -511,9 +811,13 @@ class VerificationScheduler:
         Idempotent."""
         with self._lock:
             self._closed = True
-            dropped = [] if drain else list(self._queue)
+            dropped: List[_Job] = []
             if not drain:
-                self._queue.clear()
+                dropped.extend(self._serial_q)
+                self._serial_q.clear()
+                for lane in self._lanes.values():
+                    dropped.extend(lane)
+                self._lanes.clear()
             self._cond.notify_all()
         for job in dropped:
             job.future.set_exception(
@@ -565,37 +869,95 @@ class VerificationScheduler:
                     # the resolve worker died and failed everything: exit
                     # instead of idling in wait() until shutdown
                     return None
-                if self._queue:
+                if self._serial_q or any(self._lanes.values()):
                     break
                 if self._closed:
                     return None
                 self._cond.wait()
-            head = self._queue.pop(0)
-            if head.kind == _SERIAL:
+            if self._serial_q:
+                # priority order: the serial mutation lane (head-of-chain
+                # newPayload/forkchoiceUpdated) preempts ALL queued
+                # witness work — a chain-head update must never sit
+                # behind a backfill burst
+                head = self._serial_q.pop(0)
                 batch = [head]
             else:
+                head = self._pick_witness_locked()
                 batch = self._assemble_locked(head)
-            depth = len(self._queue)
+            depth = self._qlen_locked()
+            tenant_depths = {
+                j.tenant: len(self._lanes.get(j.tenant, ())) for j in batch
+            }
             self._cond.notify_all()  # wake submitters waiting for space
         metrics.gauge_set("sched.queue_depth", depth)
+        for tenant, lane_depth in tenant_depths.items():
+            metrics.gauge_set("sched.tenant_queue_depth", lane_depth, tenant=tenant)
         return batch
+
+    def _pick_witness_locked(self) -> _Job:
+        """Choose the next witness head: lanes whose head request is
+        PRIORITY_HEAD beat backfill lanes, and the tenant among the
+        eligible class comes from the smooth-weighted-round-robin picker
+        — fairness is across lanes; each lane stays FIFO internally.
+        Caller holds `_lock` and guarantees at least one non-empty lane."""
+        cands = [t for t, lane in self._lanes.items() if lane]
+        head_cands = [
+            t for t in cands if self._lanes[t][0].priority == PRIORITY_HEAD
+        ]
+        tenant = self._picker.pick(head_cands or cands)
+        return self._lanes[tenant].pop(0)
+
+    def _assembly_wait_s_locked(self) -> float:
+        """The adaptive batching wait (qos.AdaptiveWait): re-evaluated on
+        every assembly pass against the CURRENT queue depth, exported as
+        the `sched.adaptive_wait_ms` gauge, with changes counted and
+        flight-recorded. Static max_wait_ms when adaptive_wait is off."""
+        if self._wait_policy is None:
+            return self._max_wait_s
+        chosen_ms = round(self._wait_policy.wait_ms(self._wit_len_locked()), 2)
+        if chosen_ms != self._last_wait_ms:
+            if self._last_wait_ms is not None:
+                self.stats["wait_adjustments"] += 1
+                metrics.count("sched.adaptive_wait_adjustments")
+                flight.record(
+                    "sched.adapt_wait",
+                    wait_ms=chosen_ms,
+                    prev_wait_ms=self._last_wait_ms,
+                    queue_depth=self._wit_len_locked(),
+                )
+            self._last_wait_ms = chosen_ms
+            metrics.gauge_set("sched.adaptive_wait_ms", chosen_ms)
+        return chosen_ms / 1e3
 
     def _assemble_locked(self, head: _Job) -> List[_Job]:
         """Coalesce same-bucket witness jobs behind `head` under the
-        max_batch / max_wait policy. Caller holds `_lock`; the cond wait
-        releases it so submitters keep admitting while we wait."""
+        max_batch / adaptive-wait policy. Same-bucket jobs join from
+        EVERY tenant lane (the engine dispatch is tenant-blind; fairness
+        was already enforced by the head pick), each lane drained FIFO.
+        Caller holds `_lock`; the cond wait releases it so submitters
+        keep admitting while we wait."""
         batch = [head]
-        wait_until = head.admitted + self._max_wait_s
+        # evaluate the adaptive policy once per batch up front (so the
+        # exported gauge tracks every batch, including the full-backlog
+        # ones that never reach the wait below), then again on every pass
+        self._assembly_wait_s_locked()
         while True:
-            i = 0
-            while i < len(self._queue) and len(batch) < self._max_batch:
-                j = self._queue[i]
-                if j.kind == _WITNESS and j.bucket == head.bucket:
-                    batch.append(self._queue.pop(i))
-                else:
-                    i += 1
+            for lane in self._lanes.values():
+                i = 0
+                while i < len(lane) and len(batch) < self._max_batch:
+                    if lane[i].bucket == head.bucket:
+                        batch.append(lane.pop(i))
+                    else:
+                        i += 1
+                if len(batch) >= self._max_batch:
+                    break
             if len(batch) >= self._max_batch or self._closed:
                 break
+            # the wait window shrinks as the queue deepens (a full
+            # backlog needs no coalescing delay) and is re-evaluated
+            # after every wakeup — a burst landing mid-wait cuts the
+            # remaining window short
+            wait_until = head.admitted + self._assembly_wait_s_locked()
             now = time.monotonic()
             if now >= wait_until:
                 break
@@ -608,9 +970,14 @@ class VerificationScheduler:
         gate and bench artifacts assert on the snapshot)."""
         with self._lock:
             self.stats["rejected"] += 1
-        metrics.count("sched.rejected", reason="deadline")
+            self._tenant_locked(job.tenant)["shed"] += 1
+        metrics.count("sched.rejected", reason="deadline", tenant=job.tenant)
         flight.record(
-            "sched.shed", reason="deadline", lane=job.kind, trace_id=job.trace_id
+            "sched.shed",
+            reason="deadline",
+            lane=job.kind,
+            tenant=job.tenant,
+            trace_id=job.trace_id,
         )
         job.future.set_exception(
             DeadlineExpired("deadline expired while queued")
@@ -619,23 +986,33 @@ class VerificationScheduler:
     def _expire_locked(self) -> None:
         """Fail queued jobs whose deadline has passed (without executing)."""
         now = time.monotonic()
-        live: List[_Job] = []
         expired: List[_Job] = []
-        for j in self._queue:
-            (expired if j.deadline is not None and now > j.deadline else live).append(j)
+        for q in (self._serial_q, *self._lanes.values()):
+            live = [
+                j for j in q if j.deadline is None or now <= j.deadline
+            ]
+            if len(live) != len(q):
+                expired.extend(
+                    j for j in q if j.deadline is not None and now > j.deadline
+                )
+                q[:] = live
         if not expired:
             return
-        self._queue[:] = live
         self.stats["rejected"] += len(expired)
         for j in expired:
+            self._tenant_locked(j.tenant)["shed"] += 1
             # set_exception never raises here: these futures have no
             # waiter-side cancellation path
             j.future.set_exception(
                 DeadlineExpired("deadline expired while queued")
             )
-            metrics.count("sched.rejected", reason="deadline")
+            metrics.count("sched.rejected", reason="deadline", tenant=j.tenant)
             flight.record(
-                "sched.shed", reason="deadline", lane=j.kind, trace_id=j.trace_id
+                "sched.shed",
+                reason="deadline",
+                lane=j.kind,
+                tenant=j.tenant,
+                trace_id=j.trace_id,
             )
 
     def _execute(self, batch: List[_Job]) -> None:
@@ -702,6 +1079,7 @@ class VerificationScheduler:
             stage=stage,
             batch_size=len(batch),
             bucket_bytes=batch[0].bucket if lane == _WITNESS else None,
+            tenants=sorted({j.tenant for j in batch}),
             trace_ids=trace_ids,
         )
         if pipelined:
@@ -725,8 +1103,10 @@ class VerificationScheduler:
 
     def _execute_serial(self, job: _Job, batch_id: int) -> None:
         metrics.count("sched.batches", lane="serial")
+        metrics.count("sched.tenant_served", tenant=job.tenant)
         with self._lock:
             self.stats["serial_jobs"] += 1
+            self._tenant_locked(job.tenant)["served"] += 1
         if job.deadline is not None and time.monotonic() > job.deadline:
             self._shed_expired(job)
             return
@@ -740,6 +1120,7 @@ class VerificationScheduler:
                 batch_id=batch_id,
                 lane=_SERIAL,
                 batch_size=1,
+                tenants=[job.tenant],
                 ok=ok,
                 duration_ms=round((time.monotonic() - t0) * 1e3, 3),
                 queue_wait_ms=round((t0 - job.admitted) * 1e3, 3),
@@ -897,11 +1278,14 @@ class VerificationScheduler:
         total = sum(j.nbytes for j in jobs)
         padded = _pow2ceil(total)
         done = time.monotonic()
+        served: dict = {}
         for j, ok in zip(jobs, verdicts):
+            served[j.tenant] = served.get(j.tenant, 0) + 1
             # meta BEFORE set_result: a waiter that observed the verdict
             # must also observe its batch record (verify_traced)
             j.meta = {
                 **record,
+                "tenant": j.tenant,
                 "queue_wait_ms": round((picked - j.admitted) * 1e3, 3),
             }
             _safe_resolve(j.future, bool(ok))
@@ -910,11 +1294,16 @@ class VerificationScheduler:
             lane=_WITNESS,
             duration_ms=round((done - picked) * 1e3, 3),
             n_ok=int(sum(bool(ok) for ok in verdicts)),
+            tenants=sorted(served),
             trace_ids=[j.trace_id for j in jobs],
             **record,
         )
         metrics.observe_hist("sched.batch_size", n, buckets=_BATCH_BUCKETS)
         metrics.count("sched.batches", lane="witness")
+        for tenant, cnt in served.items():
+            # the per-tenant progress counter the no-starvation gates
+            # (loadgen, soak) watch
+            metrics.count("sched.tenant_served", cnt, tenant=tenant)
         metrics.gauge_set(
             "sched.padding_waste", round(1.0 - total / padded, 4) if padded else 0.0
         )
@@ -928,6 +1317,8 @@ class VerificationScheduler:
                 st["coalesced"] += n
             if n > st["max_batch_seen"]:
                 st["max_batch_seen"] = n
+            for tenant, cnt in served.items():
+                self._tenant_locked(tenant)["served"] += cnt
 
     # -- resolve worker (pipeline_depth > 1) ---------------------------------
 
@@ -1024,11 +1415,14 @@ class VerificationScheduler:
             first = self._dead is None
             if first:
                 self._dead = exc
-            victims = batch + self._queue
+            victims = list(batch) + self._serial_q
+            for lane in self._lanes.values():
+                victims.extend(lane)
             dropped_items = list(self._resolve_q)
             for item in dropped_items:
                 victims.extend(item["jobs"])
-            self._queue = []
+            self._serial_q = []
+            self._lanes = {}
             self._resolve_q = []
             self._inflight_list = []
             batch_id = self._batch_seq

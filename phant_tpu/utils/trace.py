@@ -161,11 +161,16 @@ METRIC_HELP: Dict[str, str] = {
     "witness_engine.dispatch": "Dispatch stage: device keccak enqueue of the novel nodes, no host sync (begin_batch)",
     "witness_engine.resolve": "Resolve stage: digest readback/hash outside the lock + commit + linkage join (resolve_batch)",
     # continuous-batching scheduler (phant_tpu/serving/)
-    "sched.queue_depth": "Verification requests currently in the scheduler admission queue",
+    "sched.queue_depth": "Verification requests currently in the scheduler admission queue (all lanes)",
+    "sched.tenant_queue_depth": "Witness requests currently queued, by tenant lane",
     "sched.batch_size": "Assembled witness-batch sizes (requests per engine dispatch)",
     "sched.queue_wait_seconds": "Admission-to-execution wait per scheduled request",
     "sched.coalesced_requests": "Requests that shared an engine batch with at least one other request",
-    "sched.rejected": "Scheduler rejections by reason (queue_full/deadline/down/shutdown)",
+    "sched.rejected": "Overload rejections by reason (queue_full/tenant_quota/evicted/saturated/deadline/down/shutdown) and tenant",
+    "sched.tenant_served": "Requests completed by the scheduler, by tenant (the no-starvation progress counter)",
+    "sched.backfill_evictions": "Witness jobs evicted to admit head-of-chain work (backfill first; head-class witness only for a serial mutation), by shed tenant",
+    "sched.adaptive_wait_ms": "Current adaptive batching wait chosen by the queue-depth policy (serving/qos.py)",
+    "sched.adaptive_wait_adjustments": "Times the adaptive policy changed the assembly wait (shrink under load, widen when idle)",
     "sched.batches": "Scheduler executions by lane (witness batches / serial jobs)",
     "sched.padding_waste": "Unused fraction of the padded device buffer the last witness batch would occupy",
     "sched.executor_crashes": "Scheduler executor crashes (scheduler marked down, /healthz -> 503)",
@@ -196,8 +201,9 @@ SPAN_HELP: Dict[str, str] = {
     # flight-event kinds (phant_tpu/obs/flight.py ring records)
     "span": "A completed top-level span record (mirrored from the span sink)",
     "error": "An exception record (stateless execution aborts and other instrumented failures)",
-    "sched.admit": "A request admitted to the scheduler queue",
-    "sched.shed": "A request shed at admission or execution time (queue_full/deadline/down/shutdown)",
+    "sched.admit": "A request admitted to the scheduler queue (carries tenant + priority)",
+    "sched.shed": "A request shed at admission, execution, or the stateless concurrency gate (queue_full/tenant_quota/evicted/saturated/deadline/down/shutdown; carries the shed tenant)",
+    "sched.adapt_wait": "The adaptive batching policy changed the assembly wait (old/new wait + queue depth)",
     "sched.batch_start": "The executor picked up a batch (witness lane) or serial job",
     "sched.batch_done": "A batch/serial job finished; carries the batch record (size, bucket, backend, cache counts, trace ids)",
     "sched.executor_crash": "The scheduler executor died; carries the crashing batch's ids",

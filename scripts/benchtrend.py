@@ -57,13 +57,27 @@ _INFO_SUFFIXES = (
     "_attempts", "_seconds_budget",
 )
 
+#: latency-percentile keys: `..._p50_ms` / `..._p99_ms` / `..._p999_ms`
+#: (the `serving_load` section, round 6 onward) and any bare `..._p99`
+#: variant — percentiles are lower-is-better even if a future section
+#: drops the unit suffix
+_PCTL_RE = re.compile(r"_p\d{2,3}(_ms)?$")
+
 
 def _direction(key: str) -> Optional[str]:
     """'up' = higher is better, 'down' = lower is better, None = info."""
     if key.endswith(_INFO_SUFFIXES):
         return None
-    if key.endswith("_per_sec") or key.endswith("_mbps") or key == "value":
+    if (
+        key.endswith("_per_sec")
+        or key.endswith("_rps")
+        or key.endswith("_mbps")
+        or key == "value"
+    ):
+        # _rps: the serving_load goodput/capacity keys (requests/sec)
         return "up"
+    if _PCTL_RE.search(key):
+        return "down"
     if key.endswith("_ms") or key.endswith("_seconds") or key.endswith("_s"):
         return "down"
     return None

@@ -95,9 +95,12 @@ fi
 # Scheduler soak smoke AFTER the pytest groups: a live server under
 # multi-threaded mixed traffic (serial-lane newPayloads + batching-lane
 # stateless verifications) must serialize mutation exactly once, coalesce
-# witness batches, shed nothing, and drain clean (phant_tpu/serving/) —
-# then an INDUCED executor crash in a throwaway server must leave a
-# well-formed flight-recorder dump (phant_tpu/obs/).
+# witness batches, shed nothing, and drain clean (phant_tpu/serving/);
+# an INDUCED executor crash in a throwaway server must leave a
+# well-formed flight-recorder dump (phant_tpu/obs/); and a <=60s
+# fixed-seed loadgen sweep (scripts/loadgen.py, open-loop overload) must
+# show zero serial-lane sheds, nonzero adaptive-wait adjustments, and no
+# tenant starvation (the multi-tenant QoS gate).
 t0=$(date +%s)
 JAX_PLATFORMS=cpu python scripts/soak.py > build/logs/soak.log 2>&1
 rc=$?

@@ -20,7 +20,14 @@ Pass criteria (exit 1 otherwise):
   * shutdown drains cleanly (no queued work abandoned, the scheduler
     slot is released).
 
-A second phase (`_crash_phase`) then INDUCES one executor crash in a
+A fourth phase (`_qos_phase`, PR 6) runs a short fixed-seed
+scripts/loadgen.py sweep — open-loop Poisson arrivals with bursts, a 10:1
+backfill:head tenant mix, slow-loris clients — and asserts the QoS
+contract from the server's own telemetry: zero serial-lane sheds,
+nonzero adaptive-wait adjustments, no tenant starved under overload, and
+every loris connection closed by the socket deadline.
+
+A second phase (`_crash_phase`) INDUCES one executor crash in a
 throwaway server — a poisoned engine under a real HTTP
 executeStatelessPayloadV1 — and asserts the obs postmortem contract:
   * pre-crash, `GET /debug/flight` serves the ring with the request's
@@ -164,7 +171,10 @@ def main() -> int:
     rc = _crash_phase()
     if rc:
         return rc
-    return _pipeline_phase()
+    rc = _pipeline_phase()
+    if rc:
+        return rc
+    return _qos_phase()
 
 
 def _crash_phase() -> int:
@@ -371,6 +381,57 @@ def _pipeline_phase() -> int:
     print(
         "[soak] pipeline phase green: depth-2 byte-identical, resolve-stage "
         "crash fails only in-flight handles and names its stage"
+    )
+    return 0
+
+
+def _qos_phase() -> int:
+    """Multi-tenant QoS under real overload (the PR 6 gate): a short
+    fixed-seed scripts/loadgen.py run — open-loop Poisson arrivals with
+    bursts, 10:1 backfill:head tenant mix, slow-loris clients — against a
+    live EngineAPIServer. Asserts, from the server's own flight recorder
+    and metrics: the serial mutation lane was NEVER shed, the adaptive
+    batching policy actually adjusted the assembly wait, no tenant
+    starved during the overload point, and every slow-loris connection
+    was closed by the socket deadline. <=60s total
+    (PHANT_SOAK_LOADGEN_SECONDS per load point, default 5)."""
+    import loadgen
+
+    seconds = float(os.environ.get("PHANT_SOAK_LOADGEN_SECONDS", "5"))
+    result = loadgen.run_profile(
+        seed=6,
+        duration_s=seconds,
+        multipliers=(0.5, 1.0, 2.0),
+        slow_loris=2,
+        loris_timeout_s=1.5,
+        log=lambda msg: print(f"[soak] qos: {msg}", file=sys.stderr),
+    )
+    checks = result["checks"]
+    failures: list = []
+    if checks["serial_lane_sheds"] != 0:
+        failures.append(
+            f"serial mutation lane shed {checks['serial_lane_sheds']} jobs "
+            "(the documented shed order forbids it)"
+        )
+    if checks["adaptive_wait_adjustments"] <= 0:
+        failures.append("adaptive batching never adjusted the assembly wait")
+    if not checks["no_starvation"]:
+        failures.append(f"tenant(s) starved under overload: {checks['starved_tenants']}")
+    if checks["loris_all_closed"] is False:
+        failures.append(
+            f"slow-loris connections outlived the socket deadline: {result}"
+        )
+    if failures:
+        for f in failures:
+            print(f"[soak] FAIL (qos phase): {f}", file=sys.stderr)
+        return 1
+    overload = max(result["points"], key=lambda p: p["multiplier"])
+    print(
+        f"[soak] qos phase green: {len(result['points'])}-point sweep, overload "
+        f"tput {overload['tput_rps']} rps / shed {overload['shed_rate']:.0%}, "
+        f"head p99 {overload.get('head_p99_ms')}ms, "
+        f"{checks['adaptive_wait_adjustments']} adaptive-wait adjustments, "
+        f"no starvation, loris closed"
     )
     return 0
 

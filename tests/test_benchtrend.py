@@ -227,6 +227,53 @@ def test_cli_exit_codes(tmp_path):
     assert real.returncode == 0, real.stdout
 
 
+def test_serving_load_key_directions():
+    """Round-6 `serving_load` section keys: goodput/capacity (`_rps`) is
+    higher-is-better, latency percentiles are lower-is-better (with or
+    without the `_ms` unit suffix), verdict/rate keys are informational."""
+    d = benchtrend._direction
+    assert d("serving_load_peak_tput_rps") == "up"
+    assert d("serving_load_capacity_rps") == "up"
+    assert d("serving_load_p50_ms") == "down"
+    assert d("serving_load_p99_ms") == "down"
+    assert d("serving_load_p999_ms") == "down"
+    assert d("serving_load_head_p99_overload_ms") == "down"
+    assert d("some_section_p99") == "down"  # unit-less percentile variant
+    assert d("serving_load_shed_rate_overload") is None
+    assert d("serving_load_serial_sheds") is None
+    assert d("serving_load_adaptive_adjustments") is None
+    assert d("serving_load_starved_tenants") is None
+
+
+def test_serving_load_latency_regression_flags(tmp_path):
+    """A p999 blowup (the tail the QoS layer exists to bound) must flag
+    from round 6 onward; a goodput collapse likewise."""
+    for n, (p999, rps) in enumerate(
+        [(900.0, 100.0), (950.0, 104.0), (880.0, 98.0)], start=1
+    ):
+        _write_round(
+            tmp_path,
+            n,
+            {"serving_load_p999_ms": p999, "serving_load_peak_tput_rps": rps},
+        )
+    _write_round(
+        tmp_path,
+        4,
+        {"serving_load_p999_ms": 4000.0, "serving_load_peak_tput_rps": 20.0},
+    )
+    _rows, flags = benchtrend.analyze(str(tmp_path), threshold=0.4, min_prior=2)
+    assert any("serving_load_p999_ms" in f for f in flags), flags
+    assert any("serving_load_peak_tput_rps" in f for f in flags), flags
+    # improvements in either direction must not flag
+    _write_round(
+        tmp_path,
+        4,
+        {"serving_load_p999_ms": 400.0, "serving_load_peak_tput_rps": 300.0},
+    )
+    _rows, flags2 = benchtrend.analyze(str(tmp_path), threshold=0.4, min_prior=2)
+    assert flags2 == [], flags2
+
+
 def test_json_output_parses(tmp_path):
     _write_round(tmp_path, 1, {"engine_cpu_blocks_per_sec": 1000.0})
     _write_round(tmp_path, 2, {"engine_cpu_blocks_per_sec": 1010.0})

@@ -7,6 +7,17 @@ global execution lock (threaded newPayload requests must be byte-identical
 to serial execution), executor-crash fail-fast + `/healthz` 503, graceful
 drain, and the offline `verify_many` face (batching efficacy: >=64
 requests, mean engine batch > 8, verdicts identical to serial).
+
+The QoS section (PR 6) pins the multi-tenant robustness contract:
+per-tenant quotas shed only the over-quota tenant, weighted-fair dequeue
+keeps a 10:1-outweighed tenant progressing, the serial mutation lane and
+head-priority witness work preempt backfill, a full queue evicts backfill
+(never mutations) for head-of-chain arrivals, the adaptive batching wait
+tracks queue depth, sheds carry their tenant through metrics AND
+`/debug/flight`, the slow-loris socket deadline frees handler threads,
+the stateless concurrency gate sheds `saturated` — and untagged
+(single-tenant) traffic stays byte-identical to direct verify_batch at
+both pipeline depths.
 """
 
 from __future__ import annotations
@@ -197,7 +208,12 @@ def test_queue_full_rejects_with_distinct_error():
     finally:
         s.shutdown()
     snap = metrics.snapshot()
-    assert snap["counters"].get('sched.rejected{reason="queue_full"}') == 1
+    # sched.rejected carries the tenant dimension (QoS, PR 6); untagged
+    # submissions land in the default lane
+    assert (
+        snap["counters"].get('sched.rejected{reason="queue_full",tenant="default"}')
+        == 1
+    )
 
 
 def test_deadline_expires_while_queued():
@@ -876,6 +892,541 @@ def test_cli_pipeline_depth_flag():
     assert args.sched_pipeline_depth == 3
 
 
+# ---------------------------------------------------------------------------
+# multi-tenant QoS: lanes, quotas, priority, fairness, adaptive wait — PR 6
+# ---------------------------------------------------------------------------
+
+
+def test_tenant_quota_sheds_only_the_over_quota_tenant():
+    """The per-tenant cap sheds BEFORE the global bound: one tenant's
+    burst stays that tenant's problem. The reject keeps the -32050 code
+    with a distinct reason+tenant metric label."""
+    metrics.reset()
+    wits = _witness_set(8)
+    s = _sched(max_batch=4, max_wait_ms=1.0, queue_depth=64, tenant_quota=2)
+    try:
+        gate = threading.Event()
+        s.submit_serial(gate.wait)  # hold the executor
+        time.sleep(0.05)
+        futs = [
+            s.submit_witness(*wits[0], tenant="hog"),
+            s.submit_witness(*wits[1], tenant="hog"),
+        ]
+        with pytest.raises(QueueFull, match="quota"):
+            s.submit_witness(*wits[2], tenant="hog")
+        assert QueueFull.code == -32050  # shed codes unchanged
+        # the other tenant's lane is unaffected
+        futs.append(s.submit_witness(*wits[3], tenant="polite"))
+        gate.set()
+        assert all(f.result(timeout=30) for f in futs)
+        st = s.stats_snapshot()
+    finally:
+        s.shutdown()
+    assert st["tenants"]["hog"]["shed"] == 1
+    assert st["tenants"]["hog"]["served"] == 2
+    assert st["tenants"]["polite"] == {"admitted": 1, "served": 1, "shed": 0}
+    snap = metrics.snapshot()
+    assert (
+        snap["counters"].get('sched.rejected{reason="tenant_quota",tenant="hog"}')
+        == 1
+    )
+
+
+def test_verify_many_blocks_on_tenant_quota_instead_of_shedding():
+    """An offline wait_for_space caller inside a tenant context must BLOCK
+    on its quota exactly as on the global bound — verify_many's contract
+    is completion, not load shedding (caught at the library boundary:
+    a tenanted verify_many over a span larger than the quota)."""
+    from phant_tpu.serving import tenant_context
+
+    wits = _witness_set(24)
+    direct = WitnessEngine().verify_batch(wits)
+    s = _sched(max_batch=4, max_wait_ms=1.0, queue_depth=64, tenant_quota=4)
+    try:
+        with tenant_context("offline"):
+            out = s.verify_many(wits)
+        st = s.stats_snapshot()
+    finally:
+        s.shutdown()
+    assert (out == direct).all() and out.all()
+    assert st["tenants"]["offline"] == {"admitted": 24, "served": 24, "shed": 0}
+    assert st["rejected"] == 0
+
+
+def test_weighted_fair_dequeue_light_tenant_not_starved_by_10x_heavy():
+    """Two tenants at 10:1 offered load, enqueued heavy-first while the
+    executor is held: under the old single FIFO the light tenant's jobs
+    would all complete LAST; weighted-fair dequeue must interleave them so
+    the light tenant drains long before the heavy backlog does. Distinct
+    shape buckets keep every batch single-tenant, so the flight records
+    give the exact service order."""
+    from phant_tpu.obs.flight import flight
+
+    heavy = _witness_set(40, trie_size=64, picks=2, seed=21)  # small bucket
+    light = _witness_set(4, trie_size=2048, picks=32, seed=22)  # big bucket
+    s = _sched(max_batch=4, max_wait_ms=1.0, queue_depth=4096)
+    try:
+        gate = threading.Event()
+        s.submit_serial(gate.wait)
+        time.sleep(0.05)
+        hv = [s.submit_witness(*w, tenant="heavy") for w in heavy]
+        lt = [s.submit_witness(*w, tenant="light") for w in light]
+        mark = len(flight.records())
+        gate.set()
+        assert all(f.result(timeout=60) for f in hv + lt)
+        dones = [
+            r
+            for r in flight.records()[mark:]
+            if r.get("kind") == "sched.batch_done" and r.get("lane") == "witness"
+        ]
+        st = s.stats_snapshot()
+    finally:
+        s.shutdown()
+    assert st["tenants"]["heavy"]["served"] == 40
+    assert st["tenants"]["light"]["served"] == 4
+    last_light = max(
+        i for i, r in enumerate(dones) if "light" in (r.get("tenants") or [])
+    )
+    last_heavy = max(
+        i for i, r in enumerate(dones) if "heavy" in (r.get("tenants") or [])
+    )
+    # the light tenant finished well before the heavy backlog (FIFO would
+    # put it dead last); half the batch sequence is a generous bound for
+    # a 10:1 imbalance under 1:1 weights
+    assert last_light < last_heavy, (last_light, last_heavy)
+    assert last_light <= len(dones) // 2, (last_light, len(dones))
+
+
+def test_tenant_weights_skew_service_order():
+    """An explicit 4:1 weight makes the favored tenant drain ~4 lanes'
+    worth of batches per round of the other's one."""
+    from phant_tpu.obs.flight import flight
+
+    a = _witness_set(12, trie_size=64, picks=2, seed=31)
+    b = _witness_set(12, trie_size=2048, picks=32, seed=32)
+    s = VerificationScheduler(
+        engine=WitnessEngine(),
+        config=SchedulerConfig(
+            max_batch=1,
+            max_wait_ms=1.0,
+            queue_depth=4096,
+            tenant_weights={"vip": 4.0, "std": 1.0},
+        ),
+    )
+    try:
+        gate = threading.Event()
+        s.submit_serial(gate.wait)
+        time.sleep(0.05)
+        futs = [s.submit_witness(*w, tenant="std") for w in b]
+        futs += [s.submit_witness(*w, tenant="vip") for w in a]
+        mark = len(flight.records())
+        gate.set()
+        assert all(f.result(timeout=60) for f in futs)
+        dones = [
+            r
+            for r in flight.records()[mark:]
+            if r.get("kind") == "sched.batch_done" and r.get("lane") == "witness"
+        ]
+    finally:
+        s.shutdown()
+    # among the first 10 single-request batches, vip got ~4x std's share
+    head = [r["tenants"][0] for r in dones[:10] if r.get("tenants")]
+    assert head.count("vip") >= 7, head
+
+
+def test_serial_mutation_preempts_queued_backfill():
+    """A newPayload-shaped serial job admitted BEHIND a deep backfill
+    queue must run before it (the priority class the QoS layer exists
+    for) — with zero witness futures resolved when the mutation runs."""
+    wits = _witness_set(24)
+    s = _sched(max_batch=4, max_wait_ms=1.0, queue_depth=4096)
+    try:
+        gate = threading.Event()
+        s.submit_serial(gate.wait)
+        time.sleep(0.05)
+        futs = [s.submit_witness(*w, tenant="backfill") for w in wits]
+        done_at_mutation = []
+        probe = s.submit_serial(
+            lambda: done_at_mutation.append(sum(f.done() for f in futs))
+        )
+        gate.set()
+        probe.result(timeout=30)
+        assert all(f.result(timeout=30) for f in futs)
+    finally:
+        s.shutdown()
+    assert done_at_mutation == [0], done_at_mutation
+
+
+def test_head_priority_witness_served_before_backfill_lanes():
+    from phant_tpu.obs.flight import flight
+    from phant_tpu.serving import PRIORITY_HEAD
+
+    backfill = _witness_set(12, trie_size=64, picks=2, seed=41)
+    urgent = _witness_set(1, trie_size=2048, picks=32, seed=42)
+    s = _sched(max_batch=4, max_wait_ms=1.0, queue_depth=4096)
+    try:
+        gate = threading.Event()
+        s.submit_serial(gate.wait)
+        time.sleep(0.05)
+        bf = [s.submit_witness(*w, tenant="bf") for w in backfill]
+        hd = s.submit_witness(
+            *urgent[0], tenant="cl", priority=PRIORITY_HEAD
+        )
+        mark = len(flight.records())
+        gate.set()
+        assert hd.result(timeout=30)
+        assert all(f.result(timeout=30) for f in bf)
+        dones = [
+            r
+            for r in flight.records()[mark:]
+            if r.get("kind") == "sched.batch_done" and r.get("lane") == "witness"
+        ]
+    finally:
+        s.shutdown()
+    # the head-class witness batch ran FIRST despite 12 earlier arrivals
+    assert dones[0].get("tenants") == ["cl"], dones[0]
+
+
+def test_backfill_evicted_to_admit_head_work_on_full_queue():
+    """Global queue full of backfill + an arriving head-class job: the
+    NEWEST backfill job is evicted (QueueFull, reason=evicted, its tenant
+    labeled) and the head job is admitted — the documented shed order.
+    The serial lane itself is never the victim."""
+    metrics.reset()
+    wits = _witness_set(6)
+    s = _sched(max_batch=4, max_wait_ms=1.0, queue_depth=3)
+    try:
+        gate = threading.Event()
+        s.submit_serial(gate.wait)
+        time.sleep(0.05)
+        bf = [s.submit_witness(*w, tenant="bf") for w in wits[:3]]  # full
+        mutation = s.submit_serial(lambda: "applied")
+        # the newest backfill future was evicted with the overload code
+        with pytest.raises(QueueFull, match="evicted"):
+            bf[-1].result(timeout=30)
+        gate.set()
+        assert mutation.result(timeout=30) == "applied"
+        assert all(f.result(timeout=30) for f in bf[:2])
+        st = s.stats_snapshot()
+    finally:
+        s.shutdown()
+    assert st["evicted"] == 1
+    assert st["tenants"]["bf"]["shed"] == 1
+    snap = metrics.snapshot()
+    assert (
+        snap["counters"].get('sched.rejected{reason="evicted",tenant="bf"}') == 1
+    )
+    assert (
+        snap["counters"].get('sched.backfill_evictions{tenant="bf"}') == 1
+    )
+
+
+def test_serial_mutation_never_shed_by_head_witness_pressure():
+    """A full queue of HEAD-class witness jobs must not reject an
+    arriving serial mutation: the serial lane outranks every witness
+    class, so the newest head-class witness job is evicted instead
+    (a mutation can only be rejected by its OWN class's backlog)."""
+    from phant_tpu.serving import PRIORITY_HEAD
+
+    wits = _witness_set(4)
+    s = _sched(max_batch=4, max_wait_ms=1.0, queue_depth=3)
+    try:
+        gate = threading.Event()
+        s.submit_serial(gate.wait)
+        time.sleep(0.05)
+        hw = [
+            s.submit_witness(*w, tenant="cl", priority=PRIORITY_HEAD)
+            for w in wits[:3]
+        ]  # queue full, all head class
+        mutation = s.submit_serial(lambda: "applied")
+        with pytest.raises(QueueFull, match="evicted"):
+            hw[-1].result(timeout=30)  # newest head witness paid
+        gate.set()
+        assert mutation.result(timeout=30) == "applied"
+        assert all(f.result(timeout=30) for f in hw[:2])
+    finally:
+        s.shutdown()
+
+
+def test_head_witness_at_quota_evicts_own_tenants_backfill():
+    """A head-class arrival at its tenant quota must not be shed by its
+    own tenant's BACKFILL backlog: the lane's newest backfill job is
+    evicted instead (head work only sheds under head-class pressure)."""
+    from phant_tpu.serving import PRIORITY_HEAD
+
+    wits = _witness_set(6)
+    s = _sched(max_batch=4, max_wait_ms=1.0, queue_depth=64, tenant_quota=2)
+    try:
+        gate = threading.Event()
+        s.submit_serial(gate.wait)
+        time.sleep(0.05)
+        bf = [s.submit_witness(*w, tenant="cl") for w in wits[:2]]  # at quota
+        head = s.submit_witness(*wits[2], tenant="cl", priority=PRIORITY_HEAD)
+        with pytest.raises(QueueFull, match="evicted"):
+            bf[-1].result(timeout=30)  # newest backfill paid for `head`
+        head2 = s.submit_witness(*wits[3], tenant="cl", priority=PRIORITY_HEAD)
+        with pytest.raises(QueueFull, match="evicted"):
+            bf[0].result(timeout=30)  # the remaining backfill paid next
+        # a quota full of HEAD work does shed the next head arrival: its
+        # own class's pressure is the one legitimate source
+        with pytest.raises(QueueFull, match="quota"):
+            s.submit_witness(*wits[4], tenant="cl", priority=PRIORITY_HEAD)
+        gate.set()
+        assert head.result(timeout=30) and head2.result(timeout=30)
+    finally:
+        s.shutdown()
+
+
+def test_eviction_never_picks_wait_for_space_jobs():
+    """verify_many's jobs (wait_for_space=True) are completion-contract:
+    a head-class arrival on a full queue must evict none of them — with
+    nothing sheddable queued, the head arrival itself is rejected."""
+    from phant_tpu.serving import PRIORITY_HEAD
+
+    wits = _witness_set(4)
+    s = _sched(max_batch=4, max_wait_ms=1.0, queue_depth=2)
+    try:
+        gate = threading.Event()
+        s.submit_serial(gate.wait)
+        time.sleep(0.05)
+        protected = [
+            s.submit_witness(*w, wait_for_space=True) for w in wits[:2]
+        ]  # queue full of unsheddable offline jobs
+        with pytest.raises(QueueFull, match="queue full"):
+            s.submit_witness(*wits[2], priority=PRIORITY_HEAD)
+        gate.set()
+        assert all(f.result(timeout=30) for f in protected)  # none evicted
+    finally:
+        s.shutdown()
+
+
+def test_adaptive_wait_adjusts_and_exports_gauge():
+    metrics.reset()
+    wits = _witness_set(96)
+    with _sched(max_batch=8, max_wait_ms=20.0, queue_depth=4096) as s:
+        assert s.verify_many(wits).all()
+        st = s.stats_snapshot()
+    assert st["wait_adjustments"] >= 1, st
+    snap = metrics.snapshot()
+    assert "sched.adaptive_wait_ms" in snap["gauges"]
+    assert snap["counters"].get("sched.adaptive_wait_adjustments", 0) >= 1
+    # an idle scheduler's wait returns to the configured ceiling; under a
+    # 96-deep backlog it must have dipped below it at least once — the
+    # flight ring carries the transition record
+    from phant_tpu.obs.flight import flight
+
+    adapts = [r for r in flight.records() if r.get("kind") == "sched.adapt_wait"]
+    assert adapts and any(r["wait_ms"] < 20.0 for r in adapts), adapts[-3:]
+
+
+def test_adaptive_wait_off_is_static():
+    metrics.reset()
+    wits = _witness_set(48)
+    s = VerificationScheduler(
+        engine=WitnessEngine(),
+        config=SchedulerConfig(
+            max_batch=8, max_wait_ms=5.0, queue_depth=4096, adaptive_wait=False
+        ),
+    )
+    try:
+        assert s.verify_many(wits).all()
+        st = s.stats_snapshot()
+    finally:
+        s.shutdown()
+    assert st["wait_adjustments"] == 0
+    assert metrics.snapshot()["counters"].get("sched.adaptive_wait_adjustments", 0) == 0
+
+
+def test_max_tenants_folds_overflow_lane():
+    """Spraying distinct tenant tags must not grow per-tenant state without
+    bound: past max_tenants, new tags share the OVERFLOW lane."""
+    from phant_tpu.serving.qos import OVERFLOW_TENANT
+
+    wits = _witness_set(12)
+    s = VerificationScheduler(
+        engine=WitnessEngine(),
+        config=SchedulerConfig(
+            max_batch=4, max_wait_ms=1.0, queue_depth=4096, max_tenants=3
+        ),
+    )
+    try:
+        futs = [
+            s.submit_witness(*wits[i], tenant=f"spray-{i}") for i in range(12)
+        ]
+        assert all(f.result(timeout=30) for f in futs)
+        st = s.stats_snapshot()
+    finally:
+        s.shutdown()
+    assert len(st["tenants"]) <= 4  # 3 tracked + the overflow fold
+    assert OVERFLOW_TENANT in st["tenants"]
+    assert sum(t["served"] for t in st["tenants"].values()) == 12
+
+
+def test_single_tenant_defaults_byte_identical_to_direct_engine_both_depths():
+    """The QoS satellite contract: untagged traffic (verify_many, the
+    spec-runner --sched path) passes through the tenant/priority defaults
+    unchanged — verdicts byte-identical to direct verify_batch at
+    pipeline depths 1 AND 2, everything accounted to the default lane."""
+    wits = _witness_set(64)
+    bad = list(wits)
+    bad[7] = (bad[7][0], bad[7][1] + [b"\x01" * 40])
+    bad[13] = (bad[13][0], [])
+    direct = WitnessEngine().verify_batch(bad)
+    for depth in (1, 2):
+        with _sched(
+            max_batch=16, max_wait_ms=2.0, queue_depth=4096, pipeline_depth=depth
+        ) as s:
+            out = s.verify_many(bad)
+            st = s.stats_snapshot()
+        assert (out == direct).all(), depth
+        assert list(st["tenants"]) == ["default"], st["tenants"]
+        assert st["tenants"]["default"]["served"] == len(bad)
+        assert st["rejected"] == 0 and st["evicted"] == 0
+
+
+def test_http_shed_carries_tenant_label_in_flight_ring():
+    """A shed tenant's rejects must carry its tenant tag all the way to
+    `/debug/flight` (the fairness postmortem surface)."""
+    chain, rpc, _root = _stateless_request()
+    sched = _sched(max_batch=4, max_wait_ms=1.0, queue_depth=1)
+    server = EngineAPIServer(chain, host="127.0.0.1", port=0, scheduler=sched)
+    server.serve_in_background()
+    try:
+        base = f"http://127.0.0.1:{server.port}"
+        gate = threading.Event()
+        sched.submit_serial(gate.wait)  # hold the executor
+        time.sleep(0.05)
+        sched.submit_witness(*_witness_set(1)[0], tenant="filler")  # queue full
+        req = urllib.request.Request(
+            base + "/",
+            data=json.dumps(rpc).encode(),
+            headers={
+                "Content-Type": "application/json",
+                "X-Phant-Tenant": "shed-me",
+            },
+        )
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(req, timeout=30)
+        gate.set()
+        assert ei.value.code == 503
+        body = json.loads(ei.value.read())
+        assert body["error"]["code"] == -32050
+        ring = json.loads(
+            urllib.request.urlopen(base + "/debug/flight", timeout=10).read()
+        )["records"]
+    finally:
+        server.shutdown()
+        sched.shutdown()
+    sheds = [
+        r
+        for r in ring
+        if r.get("kind") == "sched.shed" and r.get("tenant") == "shed-me"
+    ]
+    assert sheds and sheds[-1]["reason"] == "queue_full", sheds
+
+
+def test_slow_loris_read_deadline_frees_handler_and_counts(monkeypatch):
+    """A client that sends headers and stalls mid-body must be dropped by
+    the socket deadline (not pin a handler thread), counted in the
+    existing client-disconnect metric, with the server still serving."""
+    import socket as socketlib
+
+    monkeypatch.setenv("PHANT_HTTP_TIMEOUT_S", "1")
+    metrics.reset()
+    chain, rpc, _root = _stateless_request()
+    server = EngineAPIServer(chain, host="127.0.0.1", port=0)
+    server.serve_in_background()
+    try:
+        base = f"http://127.0.0.1:{server.port}"
+        sock = socketlib.create_connection(("127.0.0.1", server.port))
+        sock.sendall(
+            b"POST / HTTP/1.1\r\nHost: t\r\nContent-Type: application/json\r\n"
+            b"Content-Length: 512\r\n\r\n" + b'{"never-finishes'
+        )
+        sock.settimeout(6)
+        t0 = time.monotonic()
+        assert sock.recv(1024) == b""  # server hung up, well under 6s
+        assert time.monotonic() - t0 < 5.0
+        sock.close()
+        snap = metrics.snapshot()
+        assert snap["counters"].get("engine_api.client_disconnects", 0) >= 1
+        # the freed server still answers real traffic
+        code, body = _post(base, rpc)
+        assert code == 200 and body["result"]["status"] == "VALID"
+    finally:
+        server.shutdown()
+
+
+def test_http_stateless_gate_sheds_saturated_with_tenant(monkeypatch):
+    """The bounded-concurrency gate: beyond PHANT_HTTP_MAX_CONCURRENT
+    in-flight stateless executions, backfill sheds fast with -32050 and
+    the `saturated` reason carries the tenant."""
+    monkeypatch.setenv("PHANT_HTTP_MAX_CONCURRENT", "1")
+    monkeypatch.setenv("PHANT_HTTP_GATE_PATIENCE_S", "0.05")
+    metrics.reset()
+    chain, rpc, _root = _stateless_request()
+    server = EngineAPIServer(chain, host="127.0.0.1", port=0)
+    server.serve_in_background()
+    try:
+        base = f"http://127.0.0.1:{server.port}"
+
+        def one(_):
+            req = urllib.request.Request(
+                base + "/",
+                data=json.dumps(rpc).encode(),
+                headers={
+                    "Content-Type": "application/json",
+                    "X-Phant-Tenant": "indexer",
+                },
+            )
+            try:
+                with urllib.request.urlopen(req, timeout=30) as resp:
+                    return resp.status, json.loads(resp.read())
+            except urllib.error.HTTPError as e:
+                return e.code, json.loads(e.read())
+
+        with ThreadPoolExecutor(max_workers=16) as pool:
+            replies = list(pool.map(one, range(16)))
+    finally:
+        server.shutdown()
+    oks = [b for c, b in replies if c == 200]
+    sheds = [
+        b
+        for c, b in replies
+        if c == 503 and b.get("error", {}).get("code") == -32050
+    ]
+    assert oks and sheds, replies
+    assert len(oks) + len(sheds) == 16
+    snap = metrics.snapshot()
+    assert (
+        snap["counters"].get('sched.rejected{reason="saturated",tenant="indexer"}', 0)
+        >= 1
+    )
+
+
+def test_cli_qos_flags():
+    args = build_parser().parse_args([])
+    assert args.sched_tenant_quota is None
+    assert args.sched_tenant_weights is None
+    assert args.sched_adaptive_wait is None
+    assert args.sched_min_wait_ms is None
+    assert args.http_timeout_s is None
+    args = build_parser().parse_args(
+        [
+            "--sched-tenant-quota", "32",
+            "--sched-tenant-weights", "cl:4,indexer:1",
+            "--sched-adaptive-wait", "0",
+            "--sched-min-wait-ms", "0.5",
+            "--http-timeout-s", "10",
+        ]
+    )
+    assert args.sched_tenant_quota == 32
+    assert args.sched_tenant_weights == "cl:4,indexer:1"
+    assert args.sched_adaptive_wait == 0
+    assert args.sched_min_wait_ms == 0.5
+    assert args.http_timeout_s == 10.0
+
+
 def test_two_pipelined_schedulers_share_one_engine():
     """Two schedulers over the process-shared engine interleave their
     begin/resolve sequences arbitrarily — the engine accepts any order,
@@ -908,9 +1459,19 @@ def test_two_pipelined_schedulers_share_one_engine():
 def test_serial_job_does_not_run_on_dead_scheduler():
     """A state mutation queued behind a witness crash must FAIL, not
     execute: /healthz says 503, so committing a mutation there would be a
-    lie (the pre-fix drain returned early on death and ran it anyway)."""
-    eng = _PoisonedResolveEngine()
-    eng.armed = True  # first resolve crashes
+    lie (the pre-fix drain returned early on death and ran it anyway).
+    The witness must already be IN FLIGHT when the mutation arrives —
+    with QoS priority (PR 6) a serial job legitimately preempts witness
+    work that is still queued, so the crash window this test pins is the
+    serial lane waiting in _drain_pipeline while the resolve dies."""
+
+    class _SlowPoisonedResolve(_PoisonedResolveEngine):
+        def resolve_batch(self, h):
+            time.sleep(0.4)  # hold the pipeline so the serial job queues
+            return super().resolve_batch(h)
+
+    eng = _SlowPoisonedResolve()
+    eng.armed = True  # first resolve crashes (after the hold)
     s = VerificationScheduler(
         engine=eng,
         config=SchedulerConfig(max_batch=4, max_wait_ms=2.0, pipeline_depth=2),
@@ -918,6 +1479,7 @@ def test_serial_job_does_not_run_on_dead_scheduler():
     try:
         wits = _witness_set(2)
         fut_w = s.submit_witness(*wits[0])
+        time.sleep(0.15)  # witness picked up: dispatched, resolve running
         ran = []
         fut_s = s.submit_serial(lambda: ran.append(1) or 7)
         with pytest.raises(SchedulerDown):
