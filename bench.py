@@ -62,6 +62,15 @@ root. Verifiers measured on the SAME span:
     head-of-chain p99 under overload, shed rate, and the server-side
     no-starvation / zero-serial-shed / adaptive-wait verdicts
     (serving_load_* keys; scripts/benchtrend.py knows their directions).
+  * serving_mesh (CPU section) — mesh-sharded serving dispatch
+    (`--sched-mesh`, phant_tpu/serving/mesh_exec.py): witness throughput
+    vs device count through the scheduler's per-device executor pool
+    (bucket-affinity routing + spillover), first-pass (hash-bound) and
+    steady-state (linkage-bound) rates per point, per-device dispatch
+    counters + a lanes-active participation verdict, and verdict
+    identity to the single-device path. On this box the virtual mesh scales over
+    HOST cores (the honest floor); the ICI device model is the MULTICHIP
+    artifact.
   * engine_pipeline (device section) — the PR 5 tentpole's A/B: the
     device-routed engine through the scheduler at pipeline depth 1 vs 2
     (pack of batch N+1 overlapping device compute + digest resolve of
@@ -1590,6 +1599,130 @@ def sec_serving_load() -> dict:
     return out
 
 
+def sec_serving_mesh() -> dict:
+    """Mesh-sharded serving dispatch (phant_tpu/serving/mesh_exec.py):
+    witness throughput vs DEVICE COUNT through the scheduler's
+    `--sched-mesh` pool — per-device executors with pinned engines,
+    bucket-affinity routing, least-loaded spillover. Two rates per
+    device count on the SAME span:
+
+      * `first` — fresh per-device engines, so the span's novel-node
+        hashing dominates (the C keccak releases the GIL, so lanes
+        genuinely parallelize on host cores; on a real accelerator each
+        lane's compute is off-host entirely);
+      * `steady` — the same pool re-verifying the span it just interned
+        (linkage-join bound, the serving steady state).
+
+    HONESTY: on this CPU box the scaling axis is host cores (the virtual
+    mesh's N "devices" share one socket), so the committed curve is the
+    host-parallel floor — the ICI-scaled device model is the MULTICHIP
+    dryrun artifact, and a real-v5e re-run is the open claim (README
+    "Serving" notes this). The section asserts verdict identity to the
+    single-device path and RECORDS per-lane participation (dispatch
+    lists + `serving_mesh_d{n}_lanes_active` + the
+    `serving_mesh_all_lanes_active` verdict — participation depends on
+    timing, so it reports rather than crashes the run).
+    PHANT_BENCH_MESH_DEVICES picks the curve points (default "1,2,4,8"
+    trimmed to host cores)."""
+    from phant_tpu.ops.witness_engine import WitnessEngine
+    from phant_tpu.serving.scheduler import (
+        SchedulerConfig,
+        VerificationScheduler,
+    )
+
+    warm, span = _witness_chain()
+    n_blocks = len(span)
+    b = int(os.environ.get("PHANT_BENCH_MESH_BATCH", "32"))
+    # default curve: 1,2,4,8 lanes trimmed to the host's core count — on
+    # the CPU mesh each lane's compute runs on a host core, so points past
+    # the cores only measure oversubscription, not the dispatch layer
+    # (PHANT_BENCH_MESH_DEVICES overrides, e.g. "1,2,4,8" on a v5e host)
+    cores = max(2, os.cpu_count() or 2)
+    default_counts = ",".join(str(n) for n in (1, 2, 4, 8) if n <= cores)
+    counts = tuple(
+        int(x)
+        for x in os.environ.get(
+            "PHANT_BENCH_MESH_DEVICES", default_counts
+        ).split(",")
+    )
+    reps = int(os.environ.get("PHANT_BENCH_MESH_REPS", "2"))
+
+    # correctness first: mesh verdicts must be identical to the direct
+    # single-engine path, bad witnesses included
+    oracle_wits = list(span[:24])
+    oracle_wits[3] = (b"\x11" * 32, oracle_wits[3][1])  # corrupt: False
+    want = np.asarray(WitnessEngine().verify_batch(oracle_wits))
+    with VerificationScheduler(
+        config=SchedulerConfig(
+            max_batch=b, max_wait_ms=2.0, queue_depth=len(span) + 64,
+            mesh_devices=max(counts),
+        )
+    ) as s_chk:
+        got = s_chk.verify_many(oracle_wits)
+    assert (got == want).all(), "mesh verdicts diverge from single-device"
+
+    out: dict = {"serving_mesh_batch": b}
+    rate_by_n: dict = {}
+    for n in counts:
+        first_s = steady_s = float("inf")
+        participation = None
+        for _ in range(max(reps, 1)):
+            with VerificationScheduler(
+                config=SchedulerConfig(
+                    max_batch=b,
+                    max_wait_ms=2.0,
+                    queue_depth=len(span) + 64,
+                    mesh_devices=n,
+                )
+            ) as s:
+                t0 = time.perf_counter()
+                assert s.verify_many(span).all()
+                first_s = min(first_s, time.perf_counter() - t0)
+                t0 = time.perf_counter()
+                assert s.verify_many(span).all()
+                steady_s = min(steady_s, time.perf_counter() - t0)
+                mesh_stats = s.stats_snapshot()["mesh"]
+            dispatches = mesh_stats["dispatches"]
+            participation = sum(1 for d in dispatches if d > 0)
+        # participation is a RECORDED verdict, not an assert: whether every
+        # lane dispatched depends on timing (lanes that drain faster than
+        # assembly never back the home lane up past spill_depth), and a
+        # load-balancing outcome the code does not guarantee must not
+        # crash the bench run — the committed counters tell the story
+        out[f"serving_mesh_d{n}_lanes_active"] = participation
+        if participation < n:
+            _log(
+                f"serving_mesh: only {participation}/{n} lanes dispatched "
+                f"({dispatches}) — lanes outpaced assembly, no spill needed"
+            )
+        rate_by_n[n] = n_blocks / first_s
+        out[f"serving_mesh_d{n}_blocks_per_sec"] = round(n_blocks / first_s, 2)
+        out[f"serving_mesh_d{n}_steady_blocks_per_sec"] = round(
+            n_blocks / steady_s, 2
+        )
+        out[f"serving_mesh_d{n}_dispatches"] = dispatches
+        _bank({f"serving_mesh_d{n}_blocks_per_sec": out[f"serving_mesh_d{n}_blocks_per_sec"]})
+        _log(
+            f"serving_mesh: {n} lane(s) -> {out[f'serving_mesh_d{n}_blocks_per_sec']}"
+            f" first / {out[f'serving_mesh_d{n}_steady_blocks_per_sec']} steady blocks/s"
+        )
+    if 1 in rate_by_n and len(rate_by_n) > 1:
+        best_n = max(rate_by_n, key=rate_by_n.get)
+        out["serving_mesh_devices"] = max(counts)
+        out["serving_mesh_best_devices"] = best_n
+        out["serving_mesh_speedup"] = round(
+            rate_by_n[best_n] / rate_by_n[1], 3
+        )
+        # the acceptance surface: did every lane of the LARGEST curve
+        # point dispatch work? (1 = yes; an informational verdict, the
+        # per-point dispatch lists carry the detail)
+        out["serving_mesh_all_lanes_active"] = int(
+            out.get(f"serving_mesh_d{max(counts)}_lanes_active", 0)
+            == max(counts)
+        )
+    return out
+
+
 def sec_engine_pipeline() -> dict:
     """Pipelined witness execution A/B (the PR 5 tentpole): the same span
     through the serving scheduler at pipeline depth 1 (serialized pack ->
@@ -1704,6 +1837,7 @@ def sec_replay_device() -> dict:
 _CPU_SECTIONS = {
     "engine": sec_engine_cpu,
     "serving_load": sec_serving_load,
+    "serving_mesh": sec_serving_mesh,
     "replay": sec_replay_cpu,
     "state_root": sec_state_root_cpu,
     "ecrecover": sec_ecrecover_cpu,

@@ -118,6 +118,38 @@ def build_parser() -> argparse.ArgumentParser:
         "digest resolve of batch N; 1 serializes (the pre-pipeline "
         "behavior). Default: PHANT_SCHED_PIPELINE_DEPTH or 2",
     )
+    # mesh-sharded dispatch (phant_tpu/serving/mesh_exec.py): one
+    # pipelined executor per device, each with a device-pinned engine
+    p.add_argument(
+        "--sched-mesh",
+        type=int,
+        default=None,
+        metavar="N",
+        help="Fan witness dispatch out over N mesh devices: one pipelined "
+        "executor per device, each owning a WitnessEngine pinned to that "
+        "device, with stable bucket-affinity routing (a witness shape "
+        "keeps hitting the same device's intern table) plus least-loaded "
+        "spillover. 0 = the single-executor path. "
+        "Default: PHANT_SCHED_MESH or 0",
+    )
+    p.add_argument(
+        "--sched-mesh-dispatch",
+        choices=("affinity", "megabatch"),
+        default=None,
+        help="Mesh dispatch mode: 'affinity' routes each assembled batch "
+        "to one device; 'megabatch' additionally sends a single-bucket "
+        "batch that fills --sched-max-batch through ONE whole-mesh "
+        "sharded fused kernel call. Default: PHANT_SCHED_MESH_DISPATCH "
+        "or affinity",
+    )
+    p.add_argument(
+        "--sched-mesh-spill",
+        type=int,
+        default=None,
+        help="Home-device backlog (batches) at which a bucket's batch "
+        "spills to the least-loaded device instead. Default: "
+        "PHANT_SCHED_MESH_SPILL or 2",
+    )
     # multi-tenant QoS (phant_tpu/serving/qos.py): per-tenant lanes,
     # quotas, weighted fair dequeue, and the adaptive batching wait
     p.add_argument(
@@ -213,6 +245,13 @@ def main(argv=None) -> int:
     )
     if args.sched_pipeline_depth is not None:
         sched_kwargs["pipeline_depth"] = args.sched_pipeline_depth
+    # mesh dispatch: a flag wins over its PHANT_SCHED_MESH* env default
+    if args.sched_mesh is not None:
+        sched_kwargs["mesh_devices"] = args.sched_mesh
+    if args.sched_mesh_dispatch is not None:
+        sched_kwargs["mesh_dispatch"] = args.sched_mesh_dispatch
+    if args.sched_mesh_spill is not None:
+        sched_kwargs["mesh_spill_depth"] = args.sched_mesh_spill
     # QoS knobs: a flag wins over its PHANT_SCHED_* env default
     if args.sched_tenant_quota is not None:
         sched_kwargs["tenant_quota"] = args.sched_tenant_quota

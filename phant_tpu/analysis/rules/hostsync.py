@@ -40,6 +40,12 @@ from phant_tpu.analysis.symbols import Project, _dotted
 DEFAULT_ENTRIES: Tuple[str, ...] = (
     "phant_tpu.stateless.execute_stateless",
     "phant_tpu.ops.witness_engine.WitnessEngine.verify_batch",
+    # mesh serving (PR 7): the per-device executor loop and the routing/
+    # megabatch entries are the serving hot path — a stray sync in a lane
+    # stalls one chip's whole pipeline
+    "phant_tpu.serving.mesh_exec.MeshExecutorPool.submit",
+    "phant_tpu.serving.mesh_exec.MeshExecutorPool._run_executor",
+    "phant_tpu.serving.mesh_exec.MeshExecutorPool.run_megabatch",
 )
 
 _SCALAR_BUILTINS = ("int", "bool", "float")

@@ -15,7 +15,11 @@ Request execution goes through the continuous-batching scheduler
   threads (stateless execution shares nothing), and its witness
   verification coalesces with other in-flight requests into one
   engine/device `verify_batch` dispatch via the scheduler's batch
-  assembler (stateless.verify_witness_nodes);
+  assembler (stateless.verify_witness_nodes) — with `--sched-mesh N`
+  those dispatches fan out over N device-pinned executors
+  (serving/mesh_exec.py), and `/healthz` carries the per-device lane
+  state under `scheduler.mesh` (any dead lane turns the probe 503
+  exactly like a dead executor: routed batches would never complete);
 * scheduler rejections map to distinct JSON-RPC errors: queue full /
   tenant quota / evicted -32050, deadline expired -32051, executor down
   -32052 — all HTTP 503, counted under `sched.rejected{reason=,tenant=}`;

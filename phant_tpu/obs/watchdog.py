@@ -74,8 +74,11 @@ class Watchdog:
                 batch_id=st.get("batch_id"),
                 lane=st.get("lane"),
                 # which pipeline stage the wedged batch was in (pack/
-                # dispatch/resolve — serving/scheduler.py descriptors)
+                # dispatch/resolve — serving/scheduler.py descriptors),
+                # and which mesh device lane was running it (None on the
+                # single-executor path): a wedged chip gets NAMED
                 stage=st.get("stage"),
+                device=st.get("device"),
                 inflight_ms=round((now - st["started"]) * 1e3, 1),
                 overdue_ms=overdue_ms,
                 trace_ids=st.get("trace_ids"),

@@ -153,6 +153,7 @@ class WitnessEngine:
         hasher: Optional[object] = None,
         max_nodes: int = 1 << 20,
         device_batch_floor: int = -1,
+        device_index: Optional[int] = None,
     ):
         """device_batch_floor: minimum novel-batch size that goes to the
         device hasher under `--crypto_backend=tpu`. -1 (default) = adaptive:
@@ -161,7 +162,17 @@ class WitnessEngine:
         (~20 MB/s) never qualifies for byte-dense hashing, a locally
         attached one (~GB/s) qualifies from a few thousand nodes up. This
         is the mechanism behind round-2's "never slower than cpu" demand:
-        the flag routes by measured cost, not by hope."""
+        the flag routes by measured cost, not by hope.
+
+        device_index: pin this engine's device hashing to ONE mesh device
+        (`jax.devices()[device_index]`, resolved lazily so construction
+        never imports jax). The mesh serving pool (serving/mesh_exec.py)
+        gives each executor its own pinned engine: the engine's intern
+        table and its device dispatches stay on the same chip, so
+        bucket-affinity routing preserves the cross-block reuse the table
+        exists for. A pinned engine never takes the mesh-sharded hashing
+        path — sharding across the mesh is the POOL's axis, not one
+        engine's."""
         # native C++ core (native/engine.cc): same interning + verdict
         # semantics, ~5-10x the steady-state throughput (no Python dict
         # re-hash of node bytes, no numpy sort in the join). Preferred
@@ -201,6 +212,13 @@ class WitnessEngine:
         self._max_nodes = max_nodes
         self._hasher = hasher  # callable: List[bytes] -> List[bytes]
         self._device_batch_floor = device_batch_floor
+        # mesh pinning: the target index plus the lazily-resolved jax
+        # device handle (write-once from whatever thread first routes to
+        # the device; both writers compute the same value, so the benign
+        # race needs no lock — and the engine lock must NOT be held across
+        # a jax import anyway)
+        self._device_index = device_index
+        self._pinned = None
         self._lock = threading.Lock()  # Engine API serves from threads
         # pipelined two-phase state (begin_batch/resolve_batch), all
         # guarded by _lock: the in-flight handle count and the deferred-
@@ -251,7 +269,10 @@ class WitnessEngine:
             route_device = self._device_route_wanted(nodes)
         if route_device:
             try:
-                return self._hash_batch_device(nodes), "device"
+                return (
+                    self._device_dispatch(nodes, self._pinned_device()).resolve(),
+                    "device",
+                )
             except Exception:
                 import logging
 
@@ -269,8 +290,23 @@ class WitnessEngine:
 
         return [keccak256(n) for n in nodes], "native"
 
+    def _pinned_device(self):
+        """The jax device this engine is pinned to (device_index), or None
+        for default placement. Resolved lazily ON the device route — the
+        only path that may import jax — and memoized; indexes past the
+        device count wrap, so an 8-executor pool degrades gracefully on a
+        smaller mesh."""
+        if self._device_index is None:
+            return None
+        if self._pinned is None:
+            import jax
+
+            devices = jax.devices()
+            self._pinned = devices[self._device_index % len(devices)]
+        return self._pinned
+
     @staticmethod
-    def _device_dispatch(nodes: List[bytes]):
+    def _device_dispatch(nodes: List[bytes], device=None):
         """Enqueue one fused device dispatch of the concatenated novel
         bytes WITHOUT any host sync: returns a keccak_jax.DeviceDigests
         handle whose `resolve()` pays the readback. The transfer is the
@@ -282,7 +318,12 @@ class WitnessEngine:
         themselves are leased from `_staging` keyed by that same bucket,
         so steady-state batches stop reallocating (and page-zeroing) the
         blob every call. The lease returns to the pool on resolve, when
-        the device can no longer be reading the buffers."""
+        the device can no longer be reading the buffers.
+
+        `device` pins the dispatch: inputs are device_put-committed to
+        that one device (jax places the compute with them) and the
+        mesh-sharded route is skipped — a pinned engine is one lane of
+        the serving pool's mesh, never a whole-mesh dispatcher."""
         import jax.numpy as jnp
 
         from phant_tpu.crypto.keccak import RATE
@@ -327,7 +368,13 @@ class WitnessEngine:
         import jax
 
         sharded = os.environ.get("PHANT_ENGINE_SHARDED", "auto")
-        if sharded == "auto":
+        if device is not None:
+            # pinned engines never shard: the mesh axis belongs to the
+            # serving pool (one pinned engine per device), and a pinned
+            # dispatch sharding back across the mesh would defeat the
+            # per-device intern-table affinity the pool routes for
+            use_sharded = False
+        elif sharded == "auto":
             # default ON with >1 REAL accelerator (the production
             # multi-chip topology); the virtual CPU test mesh stays
             # single-device unless explicitly opted in — its 8 "devices"
@@ -359,6 +406,15 @@ class WitnessEngine:
                         lens,
                         max_chunks=WITNESS_MAX_CHUNKS,
                     )
+                elif device is not None:
+                    # committed inputs pin the compute with them: the
+                    # upload AND the keccak land on this engine's device
+                    out = witness_digests(
+                        jax.device_put(blob, device),
+                        jax.device_put(offsets, device),
+                        jax.device_put(lens, device),
+                        max_chunks=WITNESS_MAX_CHUNKS,
+                    )
                 else:
                     out = witness_digests(
                         jnp.asarray(blob),
@@ -378,9 +434,10 @@ class WitnessEngine:
 
     @staticmethod
     def _hash_batch_device(nodes: List[bytes]) -> List[bytes]:
-        """Synchronous device hashing: dispatch + immediate readback (the
-        pipelined path keeps the DeviceDigests handle unresolved instead
-        so batch N+1 packs while batch N computes)."""
+        """Synchronous device hashing on the DEFAULT device: dispatch +
+        immediate readback (the pipelined path keeps the DeviceDigests
+        handle unresolved instead so batch N+1 packs while batch N
+        computes; pinned engines pass their device explicitly)."""
         return WitnessEngine._device_dispatch(nodes).resolve()
 
     @staticmethod
@@ -722,7 +779,7 @@ class WitnessEngine:
                 and self._device_route_wanted(h.novel)
             ):
                 try:
-                    h.device = self._device_dispatch(h.novel)
+                    h.device = self._device_dispatch(h.novel, self._pinned_device())
                 except Exception:
                     import logging
 
@@ -1255,4 +1312,11 @@ class WitnessEngine:
             st["interned_nodes"] = len(self._row_of_bytes)
             st["interned_digests"] = len(self._refid_of_digest)
             st["core"] = "python"
+        if self._device_index is not None:
+            # mesh pinning surface: which pool lane this engine is, and —
+            # once the device route has resolved it — the actual jax
+            # device the hashing lands on
+            st["device_index"] = self._device_index
+            if self._pinned is not None:
+                st["device"] = str(self._pinned)
         return st

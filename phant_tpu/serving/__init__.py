@@ -32,6 +32,7 @@ from phant_tpu.serving.qos import (
     sanitize_tenant,
     tenant_context,
 )
+from phant_tpu.serving.mesh_exec import MeshExecutorPool, affinity_device
 from phant_tpu.serving.scheduler import (
     DeadlineExpired,
     QueueFull,
@@ -46,12 +47,14 @@ __all__ = [
     "PRIORITY_BACKFILL",
     "PRIORITY_HEAD",
     "DeadlineExpired",
+    "MeshExecutorPool",
     "QueueFull",
     "SchedulerConfig",
     "SchedulerDown",
     "SchedulerError",
     "VerificationScheduler",
     "active_scheduler",
+    "affinity_device",
     "current_priority",
     "current_tenant",
     "install",

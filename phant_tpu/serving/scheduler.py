@@ -86,6 +86,20 @@ shape instead:
   outlives a dead scheduler never leaks in-flight leases. Handle
   resolution order is a per-scheduler property only — the engine accepts
   any interleaving, so several schedulers can share one engine.
+* **Mesh dispatch** (`mesh_devices` >= 1 via `--sched-mesh N` /
+  PHANT_SCHED_MESH) — admission, tenant-fair head pick, and batch
+  assembly stay GLOBAL, but execution fans out to a `MeshExecutorPool`
+  (serving/mesh_exec.py): one pipelined executor per mesh device, each
+  owning a `WitnessEngine` pinned to that device, with stable
+  bucket-affinity routing (a shape keeps hitting the same device's
+  intern table) and least-loaded spillover once the home lane backs up.
+  `mesh_dispatch="megabatch"` additionally sends a single-bucket batch
+  that fills `max_batch` through ONE whole-mesh sharded fused kernel
+  call. The serial lane drains the whole pool first (mutation stays
+  exclusive against every device), any lane crash takes the scheduler
+  down exactly like an executor crash — with every device's
+  dispatched-but-unresolved handles abandoned — and batch/stall/crash
+  records carry the `device` that ran them.
 * **Lifecycle** — `shutdown(drain=True)` stops admission and lets the
   executor finish everything queued AND everything in the pipeline
   (graceful drain); an exception escaping batch execution — in either
@@ -217,6 +231,27 @@ def _default_max_tenants() -> int:
     return int(os.environ.get("PHANT_SCHED_MAX_TENANTS", "64"))
 
 
+def _default_mesh_devices() -> int:
+    """PHANT_SCHED_MESH (`--sched-mesh N`): per-device executors behind
+    the batch assembler. 0 (default) = the single-executor path; N >= 1
+    fans dispatch out over a MeshExecutorPool of N device-pinned
+    engines (N=1 is a one-lane pool — useful as the A/B control)."""
+    return int(os.environ.get("PHANT_SCHED_MESH", "0"))
+
+
+def _default_mesh_dispatch() -> str:
+    """PHANT_SCHED_MESH_DISPATCH: `affinity` (default — bucket-affinity
+    routing with spillover) or `megabatch` (a full single-bucket batch
+    additionally dispatches as ONE whole-mesh sharded kernel call)."""
+    return os.environ.get("PHANT_SCHED_MESH_DISPATCH", "affinity")
+
+
+def _default_mesh_spill_depth() -> int:
+    """PHANT_SCHED_MESH_SPILL: batches a bucket's home device may have
+    outstanding before new batches spill to the least-loaded device."""
+    return int(os.environ.get("PHANT_SCHED_MESH_SPILL", "2"))
+
+
 @dataclass
 class SchedulerConfig:
     """Knobs, surfaced as `--sched-*` CLI flags (phant_tpu/__main__.py)."""
@@ -240,6 +275,16 @@ class SchedulerConfig:
     min_wait_ms: float = field(default_factory=_default_min_wait_ms)
     # distinct tenant lanes before fold-over into OVERFLOW_TENANT
     max_tenants: int = field(default_factory=_default_max_tenants)
+    # --- mesh dispatch (serving/mesh_exec.py) ------------------------------
+    # per-device executors behind the assembler (0 = single-executor path)
+    mesh_devices: int = field(default_factory=_default_mesh_devices)
+    # "affinity" (bucket->device routing + spillover) or "megabatch"
+    mesh_dispatch: str = field(default_factory=_default_mesh_dispatch)
+    # home-device backlog at which a batch spills to the least-loaded lane
+    mesh_spill_depth: int = field(default_factory=_default_mesh_spill_depth)
+    # per-lane engine injection (tests/bench: doubles, shared engines);
+    # None = one device-pinned WitnessEngine per lane
+    mesh_engine_factory: Optional[Callable] = None
 
 
 _WITNESS = "witness"
@@ -273,6 +318,64 @@ def _safe_fail(future: Future, exc: BaseException) -> None:
             future.set_exception(exc)
         except Exception:
             pass  # resolved in the race window; the waiter got a verdict
+
+
+def batch_record_from_stats(
+    batch_id: int, batch_size: int, bucket: int, s0: Optional[dict], s1: Optional[dict]
+) -> dict:
+    """The inline (fused verify_batch) batch record from an engine-stats
+    delta: cache hits/misses plus the backend classification. Shared by
+    the single-executor inline path and the mesh lanes' inline path so
+    record semantics can never diverge between them. Deltas are
+    batch-attributable as long as the caller is the engine's only
+    concurrent user (true per executor/lane in the serving shapes)."""
+    record = {
+        "batch_id": batch_id,
+        "batch_size": batch_size,
+        "bucket_bytes": bucket,
+        "stage": "dispatch",
+    }
+    if s0 is not None and s1 is not None:
+        record["cache_hits"] = s1.get("hits", 0) - s0.get("hits", 0)
+        record["cache_misses"] = s1.get("hashed", 0) - s0.get("hashed", 0)
+        if s1.get("device_batches", 0) > s0.get("device_batches", 0):
+            record["backend"] = "device"
+        elif s1.get("native_batches", 0) > s0.get("native_batches", 0):
+            record["backend"] = "native"
+        else:
+            record["backend"] = "cached"  # zero novel nodes: no hashing
+    return record
+
+
+def batch_record_from_handle(
+    handle, batch_id: int, batch_size: int, bucket: int
+) -> dict:
+    """The two-phase batch record from the HANDLE (never an engine-stats
+    delta: with batches overlapping in a pipeline, a delta would blend
+    batch N's resolve with batch N+1's pack). `cache_misses` is the
+    UNIQUE novel count (handle.n_novel) so identical traffic reads the
+    same at every depth and lane — `miss` also counts within-batch
+    duplicate occurrences. Shared by the resolve worker and the mesh
+    lanes."""
+    record = {
+        "batch_id": batch_id,
+        "batch_size": batch_size,
+        "bucket_bytes": bucket,
+        "stage": "resolve",
+    }
+    total = getattr(handle, "total", None)
+    miss = getattr(handle, "miss", None)
+    n_novel = getattr(handle, "n_novel", None)
+    if total is not None and miss is not None:
+        record["cache_hits"] = total - miss
+        record["cache_misses"] = n_novel if n_novel is not None else miss
+    if getattr(handle, "device", None) is not None:
+        record["backend"] = "device"
+    elif n_novel if n_novel is not None else miss:
+        record["backend"] = "native"
+    else:
+        record["backend"] = "cached"  # zero novel nodes: no hashing
+    return record
 
 
 def _abandon_handle(engine, handle) -> None:
@@ -354,6 +457,28 @@ class VerificationScheduler:
             else None
         )
         self._engine = engine
+        # mesh dispatch: per-device executors behind the assembler. The
+        # pool is built here (its engines are jax-free until the device
+        # route engages) and the scheduler's own resolve worker is NOT —
+        # each mesh lane runs its own begin/resolve pipeline.
+        self._pool = None
+        if self.config.mesh_devices >= 1:
+            from phant_tpu.serving.mesh_exec import MeshExecutorPool
+
+            self._pool = MeshExecutorPool(
+                self.config.mesh_devices,
+                pipeline_depth=self._pipe_depth,
+                spill_depth=self.config.mesh_spill_depth,
+                dispatch=self.config.mesh_dispatch,
+                max_batch=self._max_batch,
+                engine=engine,
+                engine_factory=self.config.mesh_engine_factory,
+                on_done=self._mesh_done,
+                on_stage=self._mesh_stage,
+                on_skip=self._mesh_skip,
+                on_expired=self._shed_expired,
+                on_crash=self._mesh_crash,
+            )
         # chaos drill (obs): PHANT_SCHED_CHAOS_CRASH=1 makes the FIRST
         # witness batch crash the executor — the supported way to fire-
         # drill the postmortem path (flight dump, /healthz 503, -32052
@@ -391,6 +516,10 @@ class VerificationScheduler:
             "batched_requests": 0,
             "max_batch_seen": 0,
             "pipelined_batches": 0,
+            # mesh dispatch: batches routed into the per-device pool, and
+            # full single-bucket batches sent as whole-mesh fused calls
+            "mesh_batches": 0,
+            "megabatches": 0,
             "rejected": 0,
             # QoS: backfill jobs evicted to admit head-of-chain work, and
             # how often the adaptive policy changed the assembly wait
@@ -403,7 +532,7 @@ class VerificationScheduler:
         )
         self._thread.start()
         self._resolve_thread: Optional[threading.Thread] = None
-        if self._pipe_depth > 1:
+        if self._pipe_depth > 1 and self._pool is None:
             self._resolve_thread = threading.Thread(
                 target=self._resolve_run, name="phant-sched-resolve", daemon=True
             )
@@ -766,6 +895,14 @@ class VerificationScheduler:
             # a dead resolve worker is just as fatal as a dead executor:
             # dispatched handles would never complete
             alive = alive and self._resolve_thread.is_alive()
+        mesh = self._pool.state() if self._pool is not None else None
+        if mesh is not None:
+            # any dead device lane means routed batches would never
+            # complete — as fatal as the executor itself (healthz 503)
+            alive = alive and mesh["all_alive"]
+            inflight = sum(
+                d["queued"] + d["inflight"] for d in mesh["per_device"].values()
+            )
         out = {
             "queue_depth": depth,
             "tenant_depths": tenant_depths,
@@ -779,6 +916,8 @@ class VerificationScheduler:
             "pipeline_depth": self._pipe_depth,
             "pipeline_inflight": inflight,
         }
+        if mesh is not None:
+            out["mesh"] = mesh
         if dead is not None:
             out["error"] = repr(dead)
         return out
@@ -792,6 +931,8 @@ class VerificationScheduler:
         b = st["batches"]
         st["mean_batch"] = round(st["batched_requests"] / b, 2) if b else 0.0
         st["pipeline_depth"] = self._pipe_depth
+        if self._pool is not None:
+            st["mesh"] = self._pool.stats()
         return st
 
     def inflight_state(self) -> Optional[dict]:
@@ -826,6 +967,10 @@ class VerificationScheduler:
         self._thread.join(timeout)
         if self._resolve_thread is not None:
             self._resolve_thread.join(timeout)
+        if self._pool is not None:
+            # the executor's graceful exit already drained every lane
+            # (_drain_pipeline); this stops the lane threads
+            self._pool.shutdown(timeout)
         self._watchdog.stop(1.0)
         metrics.gauge_set("sched.queue_depth", 0)
 
@@ -856,10 +1001,14 @@ class VerificationScheduler:
         """Block until every dispatched handle has resolved (or the
         scheduler died). Called by the executor before serial jobs —
         the serial lane stays exclusive with ALL witness work, not just
-        the executor's own — and on graceful shutdown."""
+        the executor's own — and on graceful shutdown. With mesh dispatch
+        the barrier covers every DEVICE lane: a state mutation must not
+        run while any chip still holds in-flight witness work."""
         with self._lock:
             while (self._resolve_q or self._resolving) and self._dead is None:
                 self._cond.wait(0.05)
+        if self._pool is not None:
+            self._pool.drain()
 
     def _next_batch(self) -> Optional[List[_Job]]:
         with self._lock:
@@ -1048,6 +1197,15 @@ class VerificationScheduler:
                 )
                 return
             stage = "serial"
+        elif self._pool is not None:
+            # mesh fan-out: the lane executor advances the stage (and
+            # names its device) once the batch is routed; "dispatch" is
+            # what an un-routed mesh batch is doing from this thread's
+            # point of view
+            engine = None
+            pipelined = False
+            stage = "dispatch"
+            self._exec_stage = stage
         else:
             self._exec_stage = "pack"  # provisional: engine resolution
             engine = self._resolve_engine()
@@ -1067,6 +1225,7 @@ class VerificationScheduler:
                     "batch_id": batch_id,
                     "lane": lane,
                     "stage": stage,
+                    "device": None,  # set by the mesh pool once routed
                     "started": now,
                     "deadline": stall_deadline,
                     "trace_ids": trace_ids,
@@ -1086,6 +1245,11 @@ class VerificationScheduler:
             # the descriptor stays in flight until the resolve worker
             # finishes the batch (or _die clears everything)
             self._execute_witness_pipelined(batch, batch_id, engine, now)
+            return
+        if lane == _WITNESS and self._pool is not None:
+            # the descriptor stays in flight until the mesh lane finishes
+            # the batch (_mesh_done/_mesh_skip) or _die clears everything
+            self._execute_witness_mesh(batch, batch_id, now)
             return
         try:
             if lane == _SERIAL:
@@ -1181,24 +1345,9 @@ class VerificationScheduler:
         # than silently retrying into a broken engine.
         verdicts = engine.verify_batch([(j.root, j.nodes) for j in jobs])
         s1 = self._engine_cache_stats(engine)
-        record = {
-            "batch_id": batch_id,
-            "batch_size": len(jobs),
-            "bucket_bytes": jobs[0].bucket,
-            "stage": "dispatch",
-        }
-        if s0 is not None and s1 is not None:
-            # deltas are batch-attributable as long as this executor is the
-            # engine's only concurrent caller (the serving configuration);
-            # a shared offline engine can skew them by other callers' work
-            record["cache_hits"] = s1.get("hits", 0) - s0.get("hits", 0)
-            record["cache_misses"] = s1.get("hashed", 0) - s0.get("hashed", 0)
-            if s1.get("device_batches", 0) > s0.get("device_batches", 0):
-                record["backend"] = "device"
-            elif s1.get("native_batches", 0) > s0.get("native_batches", 0):
-                record["backend"] = "native"
-            else:
-                record["backend"] = "cached"  # zero novel nodes: no hashing
+        record = batch_record_from_stats(
+            batch_id, len(jobs), jobs[0].bucket, s0, s1
+        )
         self._finish_witness_jobs(jobs, verdicts, record, picked)
 
     def _execute_witness_pipelined(
@@ -1267,6 +1416,82 @@ class VerificationScheduler:
             inflight = len(self._resolve_q) + (1 if self._resolving else 0)
             self._cond.notify_all()
         metrics.gauge_set("sched.pipeline_inflight", inflight)
+
+    # -- mesh dispatch (mesh_devices >= 1, serving/mesh_exec.py) -------------
+
+    def _execute_witness_mesh(
+        self, batch: List[_Job], batch_id: int, picked: float
+    ) -> None:
+        """Fan one assembled batch out to the per-device pool: the
+        whole-mesh megabatch path when the batch fills `max_batch` from a
+        single bucket (megabatch mode), bucket-affinity routing with
+        spillover otherwise. Affinity batches complete asynchronously on
+        their lane (_mesh_done drops the descriptor); this thread goes
+        straight back to assembling the next batch — that overlap is the
+        mesh pipeline."""
+        jobs = self._shed_or_keep(batch, picked)
+        if not jobs:
+            with self._lock:
+                self._drop_inflight_locked(batch_id)
+            return
+        if self._chaos_crash:
+            raise RuntimeError(
+                "chaos drill: PHANT_SCHED_CHAOS_CRASH=1 induced executor crash"
+            )
+        pool = self._pool
+        if pool.megabatch_wanted(len(jobs)):
+            from phant_tpu.serving.mesh_exec import MegabatchUnsupported
+
+            try:
+                verdicts, record = pool.run_megabatch(jobs, batch_id)
+            except MegabatchUnsupported:
+                pass  # this batch can't take the fused path: route it
+            else:
+                with self._lock:
+                    self.stats["megabatches"] += 1
+                self._finish_witness_jobs(jobs, verdicts, record, picked)
+                with self._lock:
+                    self._drop_inflight_locked(batch_id)
+                return
+        device = pool.submit(jobs, batch_id, picked)
+        if device is None:
+            # a lane crashed while we waited for a slot: stop the executor
+            # the same way a dead resolve worker does
+            raise SchedulerDown("mesh executor pool is down")
+        with self._lock:
+            self.stats["mesh_batches"] += 1
+            for d in self._inflight_list:
+                if d["batch_id"] == batch_id:
+                    d["device"] = device
+
+    def _mesh_done(self, jobs, verdicts, record, picked, batch_id) -> None:
+        """Lane completion (pool thread): the shared completion tail, then
+        the watchdog descriptor drops."""
+        self._finish_witness_jobs(jobs, verdicts, record, picked)
+        with self._lock:
+            self._drop_inflight_locked(batch_id)
+            self._cond.notify_all()
+
+    def _mesh_skip(self, batch_id) -> None:
+        """Every job of a routed batch expired on its lane: nothing ran."""
+        with self._lock:
+            self._drop_inflight_locked(batch_id)
+            self._cond.notify_all()
+
+    def _mesh_stage(self, batch_id, stage, device) -> None:
+        """Stage tracking for the obs watchdog: the lane reports which
+        pipeline stage a routed batch is in, and on which device — a
+        wedged device call shows up as a stall record NAMING the device."""
+        with self._lock:
+            for d in self._inflight_list:
+                if d["batch_id"] == batch_id:
+                    d["stage"] = stage
+                    d["device"] = device
+
+    def _mesh_crash(self, exc, jobs, stage, device) -> None:
+        """A lane crashed (pool thread): scheduler-wide death, stage and
+        device named in the crash record."""
+        self._die(exc, list(jobs), stage=stage, device=device)
 
     def _finish_witness_jobs(
         self, jobs: List[_Job], verdicts, record: dict, picked: float
@@ -1365,32 +1590,10 @@ class VerificationScheduler:
         handle = item["handle"]
         t0 = time.monotonic()
         verdicts = self._engine.resolve_batch(handle)
-        # the batch record comes from the HANDLE, not an engine-stats
-        # delta: with batches overlapping in the pipeline, a delta would
-        # blend batch N's resolve with batch N+1's pack
-        record = {
-            "batch_id": item["batch_id"],
-            "batch_size": len(jobs),
-            "bucket_bytes": jobs[0].bucket,
-            "stage": "resolve",
-            "pack_ms": item["pack_ms"],
-        }
-        total = getattr(handle, "total", None)
-        miss = getattr(handle, "miss", None)
-        # cache_misses = UNIQUE novel nodes hashed (n_novel), matching the
-        # inline path's hashed-delta semantics — `miss` also counts
-        # within-batch duplicate occurrences and would make identical
-        # traffic read differently across pipeline depths
-        n_novel = getattr(handle, "n_novel", None)
-        if total is not None and miss is not None:
-            record["cache_hits"] = total - miss
-            record["cache_misses"] = n_novel if n_novel is not None else miss
-        if getattr(handle, "device", None) is not None:
-            record["backend"] = "device"
-        elif n_novel if n_novel is not None else miss:
-            record["backend"] = "native"
-        else:
-            record["backend"] = "cached"  # zero novel nodes: no hashing
+        record = batch_record_from_handle(
+            handle, item["batch_id"], len(jobs), jobs[0].bucket
+        )
+        record["pack_ms"] = item["pack_ms"]
         record["resolve_ms"] = round((time.monotonic() - t0) * 1e3, 3)
         self._finish_witness_jobs(jobs, verdicts, record, item["picked"])
 
@@ -1402,15 +1605,23 @@ class VerificationScheduler:
         return self._engine
 
     def _die(
-        self, exc: BaseException, batch: List[_Job], stage: Optional[str] = None
+        self,
+        exc: BaseException,
+        batch: List[_Job],
+        stage: Optional[str] = None,
+        device=None,
     ) -> None:
         """Mark the scheduler DOWN and fail fast: the crashing batch, every
         queued job, AND every dispatched-but-unresolved pipeline handle.
         `stage` names where execution died — pack/dispatch (executor),
         resolve (resolve worker), serial — so the postmortem pinpoints the
-        pipeline stage. Idempotent-by-first-caller: when the second thread
-        of a pipelined scheduler trips over the first thread's corpse, it
-        only fails its own victims (one crash record, one dump)."""
+        pipeline stage; `device` names the mesh lane when one crashed.
+        With mesh dispatch the pool dies too: queued-but-unbegun batches
+        fail fast here, and every surviving lane abandons its own
+        dispatched handles (no engine leaks a lease). Idempotent-by-
+        first-caller: when the second thread of a pipelined scheduler
+        trips over the first thread's corpse, it only fails its own
+        victims (one crash record, one dump)."""
         with self._lock:
             first = self._dead is None
             if first:
@@ -1432,6 +1643,11 @@ class VerificationScheduler:
             # never resolved, never will be: release the engine leases so
             # a shared engine keeps evicting after this scheduler's death
             _abandon_handle(engine, item["handle"])
+        pool_failed = 0
+        if self._pool is not None:
+            # queued-but-unbegun mesh batches fail fast here; lanes
+            # abandon their own begun handles as they observe the death
+            pool_failed = self._pool.kill(exc)
         if first:
             log.error("scheduler executor crashed: %r", exc, exc_info=exc)
             metrics.count("sched.executor_crashes")
@@ -1443,9 +1659,10 @@ class VerificationScheduler:
                 "sched.executor_crash",
                 batch_id=batch_id,
                 stage=stage,
+                device=device,
                 error=repr(exc),
                 crashed_trace_ids=[j.trace_id for j in batch],
-                n_failed_fast=len(victims),
+                n_failed_fast=len(victims) + pool_failed,
             )
             flight.dump("executor_crash")
         for j in victims:

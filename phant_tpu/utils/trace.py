@@ -177,6 +177,12 @@ METRIC_HELP: Dict[str, str] = {
     "sched.pipeline_depth": "Configured pipeline depth (1 = serialized pack/dispatch/resolve, the pre-pipeline behavior)",
     "sched.pipeline_inflight": "Witness batches currently between begin_batch and resolve_batch",
     "sched.pipeline_stall": "Executor waits for a free pipeline slot (resolve stage is the bottleneck)",
+    # mesh-sharded dispatch (phant_tpu/serving/mesh_exec.py)
+    "sched.mesh_devices": "Device lanes in the mesh executor pool (--sched-mesh)",
+    "sched.device_queue_depth": "Witness batches queued on a mesh device lane, by device",
+    "sched.device_dispatch": "Witness batches routed to a mesh device lane (device='mesh' = whole-mesh megabatch), by device",
+    "sched.device_stall": "Scheduler waits for a free mesh lane slot (every device at its bound)",
+    "sched.mesh_megabatches": "Full single-bucket batches dispatched as one whole-mesh sharded fused kernel call",
     # observability layer (phant_tpu/obs/)
     "sched.watchdog_stalls": "Executor stalls detected by the obs watchdog (in-flight batch past its deadline)",
     "flight.dumps": "Flight-recorder postmortem dumps written, by trigger reason",

@@ -55,6 +55,8 @@ _MULTI_RE = re.compile(r"^MULTICHIP_r(\d+)\.json$")
 _INFO_SUFFIXES = (
     "_batch", "_blocks", "_accounts", "_txs_per_block", "_per_block",
     "_attempts", "_seconds_budget",
+    # serving_mesh (round 7): device-count echoes, not rates
+    "_devices",
 )
 
 #: latency-percentile keys: `..._p50_ms` / `..._p99_ms` / `..._p999_ms`
@@ -72,9 +74,12 @@ def _direction(key: str) -> Optional[str]:
         key.endswith("_per_sec")
         or key.endswith("_rps")
         or key.endswith("_mbps")
+        or key.endswith("_speedup")
         or key == "value"
     ):
-        # _rps: the serving_load goodput/capacity keys (requests/sec)
+        # _rps: the serving_load goodput/capacity keys (requests/sec);
+        # _speedup: the serving_mesh scaling ratio (round 7) — a shrinking
+        # best-devices/one-device ratio is a real scaling regression
         return "up"
     if _PCTL_RE.search(key):
         return "down"

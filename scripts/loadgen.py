@@ -23,6 +23,13 @@ Traffic model:
   `engine_executeStatelessPayloadV1`, backfill class) and `head` (a
   consensus client: `engine_newPayloadV2` on the serial lane +
   priority-header stateless checks) at 10:1 offered load;
+* **`--profile mixed`** — the backfill tenant draws from a
+  witness-size-DIVERSE body set (build_mixed_bodies): a hot head shape
+  carrying most of the load, a same-bucket twin with different node
+  bytes, and a tail of progressively larger witnesses, weighted with
+  mainnet-shaped reuse skew (PAPERS.md 2408.14217) — so per-bucket
+  assembly, the mesh router (`--sched-mesh`), and per-device intern
+  tables are exercised under the tenant mix;
 * **slow-loris clients** — raw sockets that send headers, promise a body,
   and stall; the server's socket deadline (PHANT_HTTP_TIMEOUT_S) must
   free the pinned handler threads and count the disconnects;
@@ -183,6 +190,64 @@ def default_profiles() -> list:
     ]
 
 
+#: `--profile mixed`: witness-size-diverse stateless bodies with
+#: mainnet-shaped REUSE SKEW (PAPERS.md 2408.14217: trie-node reuse across
+#: blocks is heavy and head-skewed). Each spec is (extra_accounts,
+#: witness_accounts, salt, weight): a hot head shape carries most of the
+#: offered load (the steady-state chain-head witness every CL re-checks),
+#: a warm twin shares its shape BUCKET but not its node bytes, and a tail
+#: of progressively larger witnesses (deeper tries, more proofs -> other
+#: pow2 buckets) exercises per-bucket assembly, the mesh router's
+#: affinity/spillover split, and per-device intern tables under tenant
+#: mixes — the traffic where tenant cost skew actually bites.
+_MIXED_SPECS = (
+    (23, 0, 0, 0.45),    # hot head shape: heavy reuse, warm tables
+    (23, 0, 1, 0.15),    # same bucket, different bytes (intern miss)
+    (63, 8, 0, 0.15),    # mid-size witness
+    (127, 24, 0, 0.10),
+    (255, 48, 0, 0.08),  # large witness, deep proofs
+    (319, 96, 1, 0.07),  # cold tail: rare, big, mostly-novel bytes
+)
+
+
+def build_mixed_bodies(log=lambda msg: None) -> tuple:
+    """([body_bytes, ...], [cumulative_weight, ...]) for the mixed
+    profile — each body an independently consensus-valid
+    executeStateless request (tests/test_serving.py _stateless_request
+    with the size knobs)."""
+    from test_serving import _stateless_request  # noqa: E402
+
+    bodies: list = []
+    weights: list = []
+    for extra, witnessed, salt, weight in _MIXED_SPECS:
+        _chain, rpc, _root = _stateless_request(
+            extra_accounts=extra, witness_accounts=witnessed, salt=salt
+        )
+        body = json.dumps(rpc).encode()
+        n_nodes = len(rpc["params"][1]["state"])
+        log(f"mixed body: {extra} accts, {n_nodes} witness nodes, w={weight}")
+        bodies.append(body)
+        weights.append(weight)
+    total = sum(weights)
+    cum, acc = [], 0.0
+    for w in weights:
+        acc += w / total
+        cum.append(acc)
+    return bodies, cum
+
+
+def _pick_body(bodies: dict, kind: str, rng):
+    """The request body for one arrival: a plain bytes entry, or a
+    weighted (bodies, cum) tuple drawn per arrival (the mixed profile's
+    reuse skew lives in these weights)."""
+    body = bodies[kind]
+    if isinstance(body, tuple):
+        blist, cum = body
+        u = rng.random()
+        return blist[next(k for k, c in enumerate(cum) if u <= c)]
+    return body
+
+
 # ---------------------------------------------------------------------------
 # percentiles (no numpy dependency on the hot path; samples are small)
 # ---------------------------------------------------------------------------
@@ -292,7 +357,7 @@ def run_point(
                 continue
             rec.outstanding += 1
         arrivals += 1
-        pool.submit(_one_request, base, prof, bodies[prof.kind], rec)
+        pool.submit(_one_request, base, prof, _pick_body(bodies, prof.kind, rng), rec)
     # drain: everything submitted gets to finish (sheds resolve fast; ok
     # replies are bounded by the server's own deadline)
     t_drain = time.monotonic()
@@ -420,18 +485,35 @@ def run_profile(
     slow_loris: int = 2,
     loris_timeout_s: float = 2.0,
     burst_factor: float = 2.0,
+    profile: str = "default",
+    mesh_devices: int = 0,
     log=lambda msg: print(f"[loadgen] {msg}", file=sys.stderr),
 ) -> dict:
     """The whole harness: (optionally self-served) server, calibration,
     the saturation sweep, slow-loris clients during the overload point,
     and the flight-recorder no-starvation verdict. Returns the result
     dict; raises nothing on QoS violations (the `checks` sub-dict carries
-    the verdicts for callers that gate — soak, tests)."""
+    the verdicts for callers that gate — soak, tests).
+
+    `profile="mixed"` swaps the single fixture witness for the
+    witness-size-diverse body set (build_mixed_bodies: mixed shape
+    buckets with mainnet-shaped reuse skew); `mesh_devices=N` serves the
+    self-served sweep through `--sched-mesh N` (per-device executors +
+    bucket-affinity routing) so the mesh router and per-device intern
+    tables are exercised under the tenant mix."""
     import numpy as np
 
     rng = np.random.default_rng(seed)
     server = None
     own_server = base is None
+    sys.path.insert(
+        0,
+        os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "tests"
+        ),
+    )
+    from test_serving import _stateless_request  # noqa: E402
+
     if own_server:
         # the handler reads the env per accepted connection: tighten the
         # read deadline so the loris verdict lands inside the run
@@ -439,14 +521,6 @@ def run_profile(
         # reuse kept-alive connections only while the server would still
         # have them open (see _IDLE_REUSE_S)
         _IDLE_REUSE_S[0] = max(0.5, loris_timeout_s * 0.6)
-        sys.path.insert(
-            0,
-            os.path.join(
-                os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "tests"
-            ),
-        )
-        from test_serving import _stateless_request  # noqa: E402
-
         from phant_tpu.engine_api.server import EngineAPIServer
         from phant_tpu.serving import SchedulerConfig
 
@@ -461,19 +535,12 @@ def run_profile(
                 queue_depth=96,
                 tenant_quota=64,
                 deadline_ms=10_000.0,
+                mesh_devices=mesh_devices,
             ),
         )
         server.serve_in_background()
         base = f"http://127.0.0.1:{server.port}"
     else:
-        sys.path.insert(
-            0,
-            os.path.join(
-                os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "tests"
-            ),
-        )
-        from test_serving import _stateless_request  # noqa: E402
-
         _chain, stateless_rpc, _root = _stateless_request()
 
     from test_serving import _valid_payload_json  # noqa: E402
@@ -488,13 +555,27 @@ def run_profile(
         "stateless": json.dumps(stateless_rpc).encode(),
         "newpayload": json.dumps(newpayload_rpc).encode(),
     }
+    if profile == "mixed":
+        bodies["stateless"] = build_mixed_bodies(log)
+    elif profile != "default":
+        raise ValueError(f"unknown loadgen profile {profile!r}")
     profiles = default_profiles()
-    result = {"seed": seed, "duration_s": duration_s, "base": base}
+    result = {
+        "seed": seed,
+        "duration_s": duration_s,
+        "base": base,
+        "profile": profile,
+        "mesh_devices": mesh_devices if own_server else None,
+    }
     try:
         log("calibrating (closed-loop) ...")
         cap = _calibrate(
             base,
-            bodies["stateless"],
+            # mixed profile: calibrate on the HOT body (the capacity that
+            # places the sweep should reflect the dominant shape)
+            bodies["stateless"][0][0]
+            if profile == "mixed"
+            else bodies["stateless"],
             {"X-Phant-Tenant": "calibrate"},
             seconds=min(4.0, duration_s / 3),
             conc=8,
@@ -645,6 +726,22 @@ def main(argv=None) -> int:
     p.add_argument("--loris-timeout", type=float, default=2.0,
                    help="server read deadline armed for self-serve runs")
     p.add_argument("--burst-factor", type=float, default=2.0)
+    p.add_argument(
+        "--profile",
+        choices=("default", "mixed"),
+        default="default",
+        help="'mixed' drives witness-size-diverse stateless bodies with "
+        "mainnet-shaped reuse skew (multiple shape buckets) instead of "
+        "the single fixture witness",
+    )
+    p.add_argument(
+        "--sched-mesh",
+        type=int,
+        default=0,
+        metavar="N",
+        help="self-served runs only: serve through a mesh executor pool "
+        "of N device lanes (--sched-mesh N on the server)",
+    )
     p.add_argument("--json", action="store_true", help="print the full result JSON")
     p.add_argument("--out", default=None, help="write the full result JSON here")
     args = p.parse_args(argv)
@@ -658,6 +755,8 @@ def main(argv=None) -> int:
         slow_loris=args.slow_loris,
         loris_timeout_s=args.loris_timeout,
         burst_factor=args.burst_factor,
+        profile=args.profile,
+        mesh_devices=args.sched_mesh,
     )
     result["bench"] = bench_keys(result)
     if args.out:

@@ -245,6 +245,31 @@ def test_serving_load_key_directions():
     assert d("serving_load_starved_tenants") is None
 
 
+def test_serving_mesh_key_directions():
+    """Round-7 `serving_mesh` section keys: per-device-count throughput
+    (`_blocks_per_sec`) and the scaling ratio (`_speedup`) are
+    higher-is-better; device-count and batch-shape echoes are
+    informational — a config change must not read as a regression."""
+    d = benchtrend._direction
+    assert d("serving_mesh_d1_blocks_per_sec") == "up"
+    assert d("serving_mesh_d8_blocks_per_sec") == "up"
+    assert d("serving_mesh_d8_steady_blocks_per_sec") == "up"
+    assert d("serving_mesh_speedup") == "up"
+    assert d("serving_mesh_devices") is None
+    assert d("serving_mesh_best_devices") is None
+    assert d("serving_mesh_batch") is None
+
+
+def test_serving_mesh_scaling_regression_flags(tmp_path):
+    """A collapsed mesh speedup (scaling broke) must flag from the
+    committed rounds onward."""
+    for n, speedup in enumerate([1.8, 1.9, 1.75], start=1):
+        _write_round(tmp_path, n, {"serving_mesh_speedup": speedup})
+    _write_round(tmp_path, 4, {"serving_mesh_speedup": 0.6})
+    rows, flags = benchtrend.analyze(str(tmp_path), 0.4, 2)
+    assert any("serving_mesh_speedup" in f for f in flags)
+
+
 def test_serving_load_latency_regression_flags(tmp_path):
     """A p999 blowup (the tail the QoS layer exists to bound) must flag
     from round 6 onward; a goodput collapse likewise."""

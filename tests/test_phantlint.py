@@ -784,6 +784,36 @@ def test_reintroduced_item_in_verify_batch_is_caught(mutated_tree, monkeypatch):
     assert "witness_engine" in hits[0].path
 
 
+def test_mesh_exec_is_in_hostsync_scope(mutated_tree, monkeypatch):
+    """The mesh serving hot path (PR 7) is HOSTSYNC-scoped: the pool's
+    entries are in DEFAULT_ENTRIES, and a stray `.item()` reintroduced
+    into a lane's executor loop turns the gate red."""
+    from phant_tpu.analysis.rules.hostsync import DEFAULT_ENTRIES
+
+    assert (
+        "phant_tpu.serving.mesh_exec.MeshExecutorPool._run_executor"
+        in DEFAULT_ENTRIES
+    )
+    assert (
+        "phant_tpu.serving.mesh_exec.MeshExecutorPool.run_megabatch"
+        in DEFAULT_ENTRIES
+    )
+    p = mutated_tree / "phant_tpu" / "serving" / "mesh_exec.py"
+    src = p.read_text()
+    mutated = src.replace(
+        "                    verdicts = engine.resolve_batch(handle)\n",
+        "                    verdicts = engine.resolve_batch(handle)\n"
+        "                    _n = verdicts.sum().item()\n",
+        1,
+    )
+    assert mutated != src
+    p.write_text(mutated)
+    res = _analyze_repo_tree(mutated_tree, monkeypatch)
+    hits = [f for f in res.new if f.rule == "HOSTSYNC" and ".item()" in f.message]
+    assert hits, [f.render() for f in res.new]
+    assert any("mesh_exec" in f.path for f in hits)
+
+
 def test_dropped_uint32_cast_is_caught(mutated_tree, monkeypatch):
     kj = mutated_tree / "phant_tpu" / "ops" / "keccak_jax.py"
     src = kj.read_text()

@@ -414,17 +414,32 @@ def test_concurrent_newpayload_identical_to_serial():
     assert sum('"VALID"' in r for r in concurrent) == 1
 
 
-def _stateless_request() -> tuple:
-    """(chain, rpc): a consensus-valid executeStateless request — one
-    signed transfer executed on a builder chain, witnessed from its
-    pre-state (the test_stateless recipe, condensed)."""
+def _stateless_request(
+    extra_accounts: int = 23, witness_accounts: int = 0, salt: int = 0
+) -> tuple:
+    """(chain, rpc, postRoot): a consensus-valid executeStateless request —
+    one signed transfer executed on a builder chain, witnessed from its
+    pre-state (the test_stateless recipe, condensed).
+
+    The shape knobs exist for witness-size-DIVERSE workloads (scripts/
+    loadgen.py `--profile mixed`): `extra_accounts` sizes the pre-state
+    trie (deeper proofs), `witness_accounts` adds that many extra filler-
+    account proofs to the witness (more nodes per request — a different
+    scheduler shape bucket), and `salt` perturbs the filler balances so
+    two same-shape bodies carry different node BYTES (distinct intern-
+    table entries). Defaults produce the original single-shape request."""
     sender_key = 0xA1A1A1
     coinbase = b"\xc0" * 20
     recipient = b"\x7e" * 20
     sender = address_from_pubkey(secp.pubkey_of(sender_key))
     accounts = {sender: Account(balance=10**20)}
-    for i in range(1, 24):
-        accounts[bytes([i]) * 20] = Account(balance=i * 10**15)
+    fillers = []
+    for i in range(1, extra_accounts + 1):
+        # one-byte pattern below 256 (the original addresses), two-byte
+        # pattern above — distinct 20-byte addresses either way
+        addr = bytes([i]) * 20 if i < 256 else i.to_bytes(2, "big") * 10
+        accounts[addr] = Account(balance=i * 10**15 + salt)
+        fillers.append(addr)
 
     parent = make_genesis_parent_header()
     base_fee = calculate_base_fee(
@@ -481,7 +496,8 @@ def _stateless_request() -> tuple:
     for addr, acct in accounts.items():
         trie.put(keccak256(addr), account_leaf(acct))
     nodes: dict = {}
-    for addr in (sender, recipient, coinbase):
+    witnessed = [sender, recipient, coinbase, *fillers[:witness_accounts]]
+    for addr in witnessed:
         for enc in generate_proof(trie, keccak256(addr)):
             nodes[enc] = None
 
