@@ -143,6 +143,18 @@ def build_parser() -> argparse.ArgumentParser:
         "or affinity",
     )
     p.add_argument(
+        "--sched-megabatch-backlog-k",
+        type=int,
+        default=None,
+        metavar="K",
+        help="With --sched-mesh-dispatch megabatch, ALSO fire the "
+        "whole-mesh fused dispatch whenever queued same-bucket work "
+        "(current batch + still-queued same-bucket jobs) reaches mesh "
+        "width x K — fusion engages under sustained overload without "
+        "sizing --sched-max-batch. 0 keeps the full-batch-only trigger. "
+        "Default: PHANT_SCHED_MEGABATCH_BACKLOG_K or 0",
+    )
+    p.add_argument(
         "--sched-mesh-spill",
         type=int,
         default=None,
@@ -252,6 +264,8 @@ def main(argv=None) -> int:
         sched_kwargs["mesh_dispatch"] = args.sched_mesh_dispatch
     if args.sched_mesh_spill is not None:
         sched_kwargs["mesh_spill_depth"] = args.sched_mesh_spill
+    if args.sched_megabatch_backlog_k is not None:
+        sched_kwargs["megabatch_backlog_k"] = args.sched_megabatch_backlog_k
     # QoS knobs: a flag wins over its PHANT_SCHED_* env default
     if args.sched_tenant_quota is not None:
         sched_kwargs["tenant_quota"] = args.sched_tenant_quota

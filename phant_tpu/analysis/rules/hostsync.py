@@ -46,6 +46,13 @@ DEFAULT_ENTRIES: Tuple[str, ...] = (
     "phant_tpu.serving.mesh_exec.MeshExecutorPool.submit",
     "phant_tpu.serving.mesh_exec.MeshExecutorPool._run_executor",
     "phant_tpu.serving.mesh_exec.MeshExecutorPool.run_megabatch",
+    # device-resident intern table (PR 8): the whole point of the
+    # resident route is that dispatch enqueues with ZERO host sync —
+    # a reintroduced readback in the scan/assign/enqueue path puts the
+    # tunnel back on the per-batch critical path and silently undoes
+    # the architecture (the resolve stage's honest syncs are annotated)
+    "phant_tpu.ops.witness_engine.WitnessEngine.begin_batch",
+    "phant_tpu.ops.witness_resident.ResidentTable.dispatch",
 )
 
 _SCALAR_BUILTINS = ("int", "bool", "float")

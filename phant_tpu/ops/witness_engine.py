@@ -113,6 +113,7 @@ class BatchHandle:
         "roots",        # concatenated roots (native) / witness list (python)
         "witnesses",    # python core linkage join
         "device",       # keccak_jax.DeviceDigests when dispatched async
+        "resident",     # witness_resident.ResidentBatch on the resident route
         "resolved",
     )
 
@@ -121,6 +122,118 @@ class BatchHandle:
             setattr(self, name, None)
         self.novel = []
         self.resolved = False
+
+
+class _DepthStats:
+    """`cache_hit_rate vs trie_depth` (PHANT_DEPTH_HIST=1): classify every
+    witness-node occurrence by its depth under its block's root and by
+    novelty, publishing the `witness_engine.depth_hits{depth=}` /
+    `depth_misses{depth=}` counter families — the /metrics surface that
+    validates the Patricia-trie reuse model (PAPERS.md 2408.14217: node
+    reuse is heavy and DEPTH-SKEWED; top-of-trie nodes should hit ~always,
+    leaf-level nodes carry the misses) against live traffic, and the
+    measurement the resident-table eviction policy leans on.
+
+    Depth needs node digests (parent->child links ARE digests), so the
+    helper keeps its own bytes -> (digest, child-ref digests) memo: a
+    never-seen node is C-hashed once HERE, and the steady state is pure
+    dict lookups plus a per-block BFS from the root. Classification: the
+    FIRST occurrence of never-memoized bytes is the MISS; every later
+    occurrence — same batch or later — is a hit, matching the engine's
+    unique-novel accounting (`cache_misses` = unique novel count, PR 5).
+    The memo flushes together with the engine's generation flushes.
+    Depth labels are bounded: "0".."6", "7+", and "u" for nodes
+    unreachable from the root (an unlinked witness — those blocks fail
+    verification anyway)."""
+
+    def __init__(self, max_nodes: int):
+        self._memo: Dict[bytes, tuple] = {}
+        self._max = max(max_nodes, 1024)
+        self._lock = threading.Lock()
+
+    def flush(self) -> None:
+        with self._lock:
+            self._memo.clear()
+
+    def record(self, witnesses) -> None:
+        hits: Dict[str, int] = {}
+        misses: Dict[str, int] = {}
+        with self._lock:
+            memo = self._memo
+            fresh: List[bytes] = []
+            seen = set()
+            for _root, nodes in witnesses:
+                for n in nodes:
+                    if n not in memo and n not in seen:
+                        seen.add(n)
+                        fresh.append(n)
+            if fresh and len(memo) + len(fresh) > self._max:
+                # bounded like the engine tables — and the clear must
+                # RE-SCAN: the batch's previously-memoized (hit) nodes
+                # are gone too, and the BFS below reads memo[n] for
+                # every node, so they must re-enter as fresh (their
+                # occurrences count as misses, exactly like an engine
+                # generation flush)
+                memo.clear()
+                fresh = []
+                seen = set()
+                for _root, nodes in witnesses:
+                    for n in nodes:
+                        if n not in seen:
+                            seen.add(n)
+                            fresh.append(n)
+            if fresh:
+                from phant_tpu.utils.native import load_native
+
+                native = load_native()
+                if native is not None:
+                    digests = list(native.keccak256_batch_fast(fresh))
+                else:
+                    from phant_tpu.crypto.keccak import keccak256
+
+                    digests = [keccak256(n) for n in fresh]
+                for n, dg in zip(fresh, digests):
+                    memo[n] = (dg, tuple(_extract_ref_digests(n)))
+            consumed: set = set()  # fresh bytes whose one miss was counted
+            for root, nodes in witnesses:
+                infos = [memo[n] for n in nodes]
+                by_digest: Dict[bytes, list] = {}
+                for i, (dg, _refs) in enumerate(infos):
+                    by_digest.setdefault(dg, []).append(i)
+                depth = [-1] * len(nodes)
+                frontier = list(by_digest.get(root, ()))
+                for i in frontier:
+                    depth[i] = 0
+                d = 0
+                while frontier:
+                    nxt: List[int] = []
+                    for i in frontier:
+                        for r in infos[i][1]:
+                            for j in by_digest.get(r, ()):
+                                if depth[j] < 0:
+                                    depth[j] = d + 1
+                                    nxt.append(j)
+                    frontier = nxt
+                    d += 1
+                for i, n in enumerate(nodes):
+                    if depth[i] < 0:
+                        lbl = "u"
+                    elif depth[i] < 7:
+                        lbl = str(depth[i])
+                    else:
+                        lbl = "7+"
+                    if n in seen and n not in consumed:
+                        consumed.add(n)
+                        tgt = misses
+                    else:
+                        tgt = hits
+                    tgt[lbl] = tgt.get(lbl, 0) + 1
+        # registry publishes outside our lock (same discipline as the
+        # engine: the metrics lock never nests inside ours)
+        for lbl, c in hits.items():
+            metrics.count("witness_engine.depth_hits", c, depth=lbl)
+        for lbl, c in misses.items():
+            metrics.count("witness_engine.depth_misses", c, depth=lbl)
 
 
 def _extract_ref_digests(node: bytes) -> List[bytes]:
@@ -154,6 +267,9 @@ class WitnessEngine:
         max_nodes: int = 1 << 20,
         device_batch_floor: int = -1,
         device_index: Optional[int] = None,
+        resident: Optional[bool] = None,
+        resident_cap: Optional[int] = None,
+        depth_hist: Optional[bool] = None,
     ):
         """device_batch_floor: minimum novel-batch size that goes to the
         device hasher under `--crypto_backend=tpu`. -1 (default) = adaptive:
@@ -172,7 +288,28 @@ class WitnessEngine:
         bucket-affinity routing preserves the cross-block reuse the table
         exists for. A pinned engine never takes the mesh-sharded hashing
         path — sharding across the mesh is the POOL's axis, not one
-        engine's."""
+        engine's.
+
+        resident: route verdicts through a DEVICE-RESIDENT intern table
+        (ops/witness_resident.py) — digest/ref rows persist on the chip
+        across batches, only truly-novel bytes are uploaded, the linkage
+        join runs on device, and the host tables commit from the device
+        digests. None (default) = auto: engaged under
+        `--crypto_backend=tpu` on a real accelerator (PHANT_RESIDENT=1
+        forces it — the XLA-CPU test/proxy path — and =0 disables).
+        True/False override the env. The per-batch offload cost model is
+        deliberately NOT consulted on this route: residency amortizes
+        each upload across every future batch, which is exactly what a
+        per-batch model cannot see (the ROADMAP tunnel lesson).
+
+        resident_cap: row bound of the resident table (default
+        min(max_nodes, PHANT_RESIDENT_CAP)); it grows toward the bound
+        in pow2 generations and flushes with the host generation.
+
+        depth_hist: record the `cache_hit_rate vs trie_depth` histogram
+        (witness_engine.depth_{hits,misses}{depth=}) on every batch.
+        None = PHANT_DEPTH_HIST (default off: first sight of a node
+        costs one extra host hash for the depth memo)."""
         # native C++ core (native/engine.cc): same interning + verdict
         # semantics, ~5-10x the steady-state throughput (no Python dict
         # re-hash of node bytes, no numpy sort in the join). Preferred
@@ -237,6 +374,17 @@ class WitnessEngine:
         # C-core engine the public intern() fills _row_of_bytes, and its
         # overflow must flush those dicts — not the warm memoized core
         self._evict_pending_py = False
+        # device-resident intern table (ops/witness_resident.py): built
+        # lazily on the first resident-routed batch, behind its own init
+        # lock (construction imports jax — the engine lock must not be
+        # held across that)
+        self._resident = None
+        self._resident_opt = resident
+        self._resident_cap = resident_cap
+        self._resident_lock = threading.Lock()
+        if depth_hist is None:
+            depth_hist = os.environ.get("PHANT_DEPTH_HIST", "0") == "1"
+        self._depth = _DepthStats(max_nodes) if depth_hist else None
         self.stats = {"hashed": 0, "hits": 0, "evictions": 0}
 
     # -- hashing backends ---------------------------------------------------
@@ -304,6 +452,121 @@ class WitnessEngine:
             devices = jax.devices()
             self._pinned = devices[self._device_index % len(devices)]
         return self._pinned
+
+    # -- device-resident intern table (ops/witness_resident.py) --------------
+
+    def _resident_wanted(self) -> bool:
+        """Route this engine's verdicts through the device-resident
+        table? Auto-on under `--crypto_backend=tpu` on a real
+        accelerator; PHANT_RESIDENT=1 forces (XLA-CPU tests/proxy), =0
+        disables; the constructor arg overrides the env. A bench hasher
+        override always wins — its batches must surface to the host
+        hashing route."""
+        if self._hasher is not None or self._resident_opt is False:
+            return False
+        env = os.environ.get("PHANT_RESIDENT", "auto")
+        if env in ("0", "off") and self._resident_opt is not True:
+            return False
+        from phant_tpu.backend import crypto_backend, jax_device_ok
+
+        if crypto_backend() != "tpu" or not jax_device_ok():
+            return False
+        if self._resident_opt is True or env == "1":
+            return True
+        try:
+            import jax
+
+            return jax.default_backend() != "cpu"
+        except Exception:
+            return False
+
+    def _resident_table(self):
+        """The engine's ResidentTable, built on first use (pinned to the
+        engine's device on a mesh lane — one independent table per chip).
+        Construction is serialized by `_resident_lock` and happens
+        OUTSIDE the engine lock (it imports jax); the handle itself is
+        engine-lock-guarded like every other table reference."""
+        with self._lock:
+            res = self._resident
+        if res is not None:
+            return res
+        with self._resident_lock:
+            with self._lock:
+                res = self._resident
+            if res is not None:
+                return res
+            from phant_tpu.ops.witness_resident import (
+                ResidentTable,
+                resident_default_cap,
+            )
+
+            table = ResidentTable(
+                max_cap=self._resident_cap
+                or min(self._max_nodes, resident_default_cap()),
+                device=self._pinned_device(),
+            )
+            with self._lock:
+                self._resident = table
+            return table
+
+    def _resident_dispatch(self, witnesses, novel):
+        """Enqueue the resident update + verdict for one batch; None =
+        this batch cannot go resident (oversized node, table failure —
+        the table is dropped on failure so a dead tunnel degrades to the
+        classic route instead of wedging every batch)."""
+        try:
+            return self._resident_table().dispatch(witnesses, novel)
+        except Exception:
+            import logging
+
+            logging.getLogger("phant.witness").warning(
+                "resident dispatch failed; dropping the device table and "
+                "falling back to the classic route",
+                exc_info=True,
+            )
+            with self._lock:
+                self._resident = None
+            return None
+
+    def reset(self) -> None:
+        """Release EVERYTHING: host tables (all cores), the python
+        twins, the device-resident arrays, and the depth memo. The bench
+        and soak use this between timed passes — constructing a fresh
+        engine resets the HOST state, but with residency the old
+        engine's device arrays would linger until GC, so pass 2 could
+        silently measure a warm resident table (or accumulate device
+        memory). Requires an idle pipeline (no in-flight handles)."""
+        with self._lock:
+            if self._inflight:
+                raise RuntimeError("reset() with in-flight batch handles")
+            if self._ext_core is not None:
+                self._ext_core.flush()
+            elif self._core is not None:
+                self._core.flush()
+            self._row_of_bytes.clear()
+            self._refid_of_digest.clear()
+            self._n_rows = 0
+            self._n_refids = 0
+            self._evict_pending = False
+            self._evict_pending_py = False
+            self.stats["resets"] = self.stats.get("resets", 0) + 1
+            res, self._resident = self._resident, None
+        if res is not None:
+            res.flush()  # drop the device arrays deterministically
+        if self._depth is not None:
+            self._depth.flush()
+
+    def _flush_attached_locked(self) -> None:
+        """Flush the device-resident table and the depth memo together
+        with a host GENERATION flush (caller holds the engine lock with
+        an empty pipeline): host and device tables evict in lockstep, so
+        they never disagree about what exists. The python-TWIN-only
+        flush (`_evict_pending_py`) deliberately does not come here —
+        the core (and its resident mirror) stay warm there."""
+        if self._resident is not None:
+            self._resident.flush()
+        if self._depth is not None:
+            self._depth.flush()
 
     @staticmethod
     def _device_dispatch(nodes: List[bytes], device=None):
@@ -690,6 +953,7 @@ class WitnessEngine:
                     # re-interned scan
                     self.stats["hits"] = hits_before
                     self._evict_all()
+                    self._flush_attached_locked()  # generation flush: sync
                     # re-intern into the new generation (lock already held)
                     return self._intern_locked(nodes)
             digests = self._hash_batch(novel)
@@ -719,6 +983,14 @@ class WitnessEngine:
         delta is captured under the engine lock so concurrent callers can
         never double-count each other's work; the registry publish happens
         after release (the metrics lock never nests inside ours)."""
+        if self._resident_wanted():
+            # the resident route is inherently two-phase (the verdict is
+            # an async device program); the one-call API is begin+resolve
+            # fused — verdict semantics stay byte-identical (the host
+            # scan is authoritative, differential-tested)
+            return self.resolve_batch(self.begin_batch(witnesses))
+        if self._depth is not None:
+            self._depth.record(witnesses)
         with metrics.phase("witness_engine.verify_batch"):
             with self._lock:
                 # eviction-window wait FIRST (it releases the lock, see
@@ -771,10 +1043,17 @@ class WitnessEngine:
         worker happens to be FIFO for per-requester ordering);
         `verify_batch` remains the one-call depth-1 equivalent and may
         interleave freely with in-flight handles."""
+        if self._depth is not None:
+            self._depth.record(witnesses)
         with metrics.phase("witness_engine.pack"):
             h = self._pack_handle(witnesses)
         with metrics.phase("witness_engine.dispatch"):
-            if h.novel and self._hasher is None and (
+            if self._resident_wanted():
+                # device-resident route: update (novel bytes only) +
+                # verdict enqueued with no host sync; the host tables
+                # will commit from the device digests at resolve
+                h.resident = self._resident_dispatch(witnesses, h.novel)
+            if h.resident is None and h.novel and self._hasher is None and (
                 not self._native_route_certain()
                 and self._device_route_wanted(h.novel)
             ):
@@ -871,6 +1150,17 @@ class WitnessEngine:
         `verify_batch` over the same witnesses."""
         with metrics.phase("witness_engine.resolve"):
             verdict, snap = self._resolve_handle(handle)
+        res = handle.resident
+        if res is not None:
+            if res.uploaded_nodes:
+                metrics.count(
+                    "witness_resident.uploaded_nodes", res.uploaded_nodes
+                )
+                metrics.count(
+                    "witness_resident.uploaded_bytes", res.uploaded_bytes
+                )
+            if res._table is not None:
+                metrics.gauge_set("witness_resident.rows", res._table.rows())
         if handle.total:
             hits = handle.total - handle.miss
             if hits:
@@ -905,6 +1195,22 @@ class WitnessEngine:
             handle.blob = handle.offsets = handle.lens = handle.joined = None
             _staging.give(key, entry)
             handle.pack_entry = None
+        if handle.resident is not None:
+            # the resident UPDATE was already enqueued and its row
+            # assignments stand — that is consistent: the device rows
+            # exist, the host prune knows it, and the host core (never
+            # committed) simply re-reports those nodes as novel next
+            # time, where the prune skips the re-upload. The verdict/
+            # digest outputs are dropped unread; the index drop-count
+            # scalars go BACK to the table (the stat must not undercount
+            # across a crash path).
+            handle.resident.verdict_out = None
+            handle.resident.digest_out = None
+            if handle.resident.dropped_outs and handle.resident._table is not None:
+                handle.resident._table.return_dropped(
+                    handle.resident.dropped_outs
+                )
+            handle.resident.dropped_outs = []
         handle.novel = []
         handle.witnesses = None
         handle.ext_batch = None
@@ -926,10 +1232,22 @@ class WitnessEngine:
         # concurrently. Any override or open offload gate surfaces the
         # novel list to the Python-visible route instead.
         ext_native_fast = (
-            h.kind == "ext" and n_novel > 0 and self._native_route_certain()
+            h.resident is None
+            and h.kind == "ext"
+            and n_novel > 0
+            and self._native_route_certain()
         )
+        verdict_dev = None
         try:
-            if h.device is not None:
+            if h.resident is not None:
+                # resident route: the device computed BOTH the verdict
+                # and the novel digests the host tables commit from —
+                # the host hashes nothing, the readback is 1 B/block +
+                # 32 B/core-novel (witness_resident.ResidentBatch)
+                verdict_dev, res_digests = h.resident.resolve()
+                digests = res_digests or None
+                backend = "resident"
+            elif h.device is not None:
                 digests = h.device.resolve()  # the honest sync (keccak_jax)
                 backend = "device"
             elif ext_native_fast:
@@ -965,20 +1283,39 @@ class WitnessEngine:
                             h.blob, h.offsets, h.lens, h.rows, h.novel_idx,
                             b"".join(digests),
                         )
-                    block_offs = np.zeros(h.n_blocks + 1, np.uint64)
-                    np.cumsum(h.counts, dtype=np.uint64, out=block_offs[1:])
-                    with metrics.phase("witness_engine.linkage_join"):
-                        verdict = self._core.verdict(h.rows, block_offs, h.roots)
+                    if verdict_dev is None:
+                        block_offs = np.zeros(h.n_blocks + 1, np.uint64)
+                        np.cumsum(h.counts, dtype=np.uint64, out=block_offs[1:])
+                        with metrics.phase("witness_engine.linkage_join"):
+                            verdict = self._core.verdict(
+                                h.rows, block_offs, h.roots
+                            )
                 else:
                     if n_novel:
                         self._commit_novel_locked(h.rows, h.novel, digests)
-                    with metrics.phase("witness_engine.linkage_join"):
-                        verdict = self._linkage_join(
-                            h.witnesses, h.rows, h.counts, h.n_blocks
-                        )
+                    if verdict_dev is None:
+                        with metrics.phase("witness_engine.linkage_join"):
+                            verdict = self._linkage_join(
+                                h.witnesses, h.rows, h.counts, h.n_blocks
+                            )
+                if verdict_dev is not None:
+                    # the device join IS the verdict on the resident
+                    # route (the host join is skipped — the ext core's
+                    # fused commit+join is the one place it still runs,
+                    # and the two are differential-tested identical)
+                    verdict = verdict_dev
                 if backend in ("device", "native"):
                     key = backend + "_batches"
                     self.stats[key] = self.stats.get(key, 0) + 1
+                elif backend == "resident":
+                    self.stats["resident_batches"] = (
+                        self.stats.get("resident_batches", 0) + 1
+                    )
+                    # a resident batch IS a device batch for routing/
+                    # record classification (batch_record_from_stats)
+                    self.stats["device_batches"] = (
+                        self.stats.get("device_batches", 0) + 1
+                    )
             finally:
                 # a failed commit poisons THIS batch but must not wedge the
                 # pipeline bookkeeping (deferred evictions would never run)
@@ -1096,6 +1433,7 @@ class WitnessEngine:
             self._core.flush()
         else:
             self._evict_all()
+        self._flush_attached_locked()
 
     def _verify_batch_locked(
         self, witnesses: Sequence[Tuple[bytes, Sequence[bytes]]]
@@ -1288,6 +1626,12 @@ class WitnessEngine:
         """Single-witness convenience wrapper (the Engine API path)."""
         return bool(self.verify_batch([(state_root, list(nodes))])[0])
 
+    def resident_table(self):
+        """The live device-resident table, or None (not yet engaged /
+        dropped). Bench + tests read its arrays and upload accounting."""
+        with self._lock:
+            return self._resident
+
     def stats_snapshot(self) -> dict:
         """Counters + derived cache-effectiveness numbers (the public
         surface behind the phant_witnessEngineStats RPC). Takes the engine
@@ -1319,4 +1663,9 @@ class WitnessEngine:
             st["device_index"] = self._device_index
             if self._pinned is not None:
                 st["device"] = str(self._pinned)
+        if self._resident is not None:
+            # device-resident intern table: rows/generation plus the
+            # upload accounting (novel bytes shipped vs pruned) — the
+            # steady-state tunnel-independence claim, auditable per lane
+            st["resident"] = self._resident.stats_snapshot()
         return st

@@ -337,6 +337,23 @@ def _ref_words_from_rows(data, ref_pos):
     return b[..., 0] | (b[..., 1] << 8) | (b[..., 2] << 16) | (b[..., 3] << 24)
 
 
+def witness_node_features(blob, offsets, lens, *, max_chunks: int):
+    """(digests, ref_words, ref_live) of every node sliced out of `blob` —
+    the per-node features the device-resident intern table persists
+    (ops/witness_resident.py): digest (B, 8), the up-to-17 child-hash
+    reference words (B, 17, 8), and which ref slots are live (B, 17).
+    Composes inside jit; exactly the gather/hash/ref-extraction pipeline
+    of `witness_verify_fused`, factored so the resident update scatters
+    the SAME features the fused kernel computes inline (the two can never
+    diverge on ref semantics — malformed nodes are ref-less on both)."""
+    data = _gather_node_rows(blob, offsets, lens, max_chunks * RATE)
+    digests = _digests_from_rows(data, lens, max_chunks=max_chunks)
+    ref_pos = _extract_ref_positions(data, lens)
+    refs = _ref_words_from_rows(data, ref_pos)
+    ref_live = (ref_pos >= 0) & (lens[:, None] > 0)
+    return digests, refs, ref_live
+
+
 @functools.partial(jax.jit, static_argnames=("max_chunks", "n_blocks"))
 def witness_verify_fused(
     blob: jax.Array,

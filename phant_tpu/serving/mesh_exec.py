@@ -160,6 +160,7 @@ class MeshExecutorPool:
         spill_depth: int = 2,
         dispatch: str = "affinity",
         max_batch: int = 128,
+        backlog_k: int = 0,
         engine: Optional[object] = None,
         engine_factory: Optional[Callable[[int], object]] = None,
         on_done: Callable = None,
@@ -181,6 +182,7 @@ class MeshExecutorPool:
         self._bound = self._spill + self._depth
         self._dispatch_mode = dispatch
         self._max_batch = max_batch
+        self._backlog_k = max(0, backlog_k)
         if engine_factory is None:
             if engine is not None:
                 engine_factory = lambda _i: engine
@@ -264,15 +266,34 @@ class MeshExecutorPool:
 
     # -- megabatch (whole-mesh fused dispatch) -------------------------------
 
-    def megabatch_wanted(self, n_jobs: int) -> bool:
-        """The whole-batch path fires only in `megabatch` mode when a
-        single bucket FILLED the assembler (`max_batch` same-shape jobs
-        queued at once): the backlog is deep enough that one sharded
-        kernel call keeps every device busy on the same dispatch."""
-        return (
-            self._dispatch_mode == "megabatch"
-            and n_jobs >= max(self._max_batch, self._n)
-        )
+    def backlog_wanted(self) -> bool:
+        """Would `megabatch_wanted` ever read a backlog count? The
+        scheduler's same-bucket backlog scan walks every queued job
+        under the global lock — it must only run when the trigger can
+        actually consume it (megabatch mode with k > 0), never on the
+        default affinity hot path."""
+        return self._dispatch_mode == "megabatch" and self._backlog_k > 0
+
+    def megabatch_wanted(self, n_jobs: int, backlog: int = 0) -> str:
+        """Should this single-bucket batch take the whole-mesh fused
+        path? Returns a truthy REASON ("full" / "backlog") or "".
+
+        * "full" — `megabatch` mode and the bucket FILLED the assembler
+          (`max_batch` same-shape jobs at once): the pre-trigger
+          behavior.
+        * "backlog" — `backlog_k > 0` and the queued same-bucket work
+          (this batch plus `backlog` still-queued same-bucket jobs) is
+          >= mesh_width x k: sustained same-shape overload engages
+          fusion WITHOUT the operator sizing max_batch
+          (`--sched-megabatch-backlog-k`; counted by the scheduler in
+          `sched.megabatch_backlog_triggers`)."""
+        if self._dispatch_mode != "megabatch":
+            return ""
+        if n_jobs >= max(self._max_batch, self._n):
+            return "full"
+        if self._backlog_k > 0 and n_jobs + backlog >= self._n * self._backlog_k:
+            return "backlog"
+        return ""
 
     def _megabatch_mesh(self):
         """The whole-mesh Mesh for fused dispatch, probed once. Raises

@@ -252,6 +252,16 @@ def _default_mesh_spill_depth() -> int:
     return int(os.environ.get("PHANT_SCHED_MESH_SPILL", "2"))
 
 
+def _default_megabatch_backlog_k() -> int:
+    """PHANT_SCHED_MEGABATCH_BACKLOG_K: with `mesh_dispatch=megabatch`,
+    ALSO fire the whole-mesh fused dispatch whenever the queued
+    same-bucket work (current batch + still-queued same-bucket jobs) is
+    >= mesh_width x k — sustained overload engages fusion without the
+    operator sizing max_batch. 0 (default) keeps the full-batch-only
+    trigger."""
+    return int(os.environ.get("PHANT_SCHED_MEGABATCH_BACKLOG_K", "0"))
+
+
 @dataclass
 class SchedulerConfig:
     """Knobs, surfaced as `--sched-*` CLI flags (phant_tpu/__main__.py)."""
@@ -282,6 +292,9 @@ class SchedulerConfig:
     mesh_dispatch: str = field(default_factory=_default_mesh_dispatch)
     # home-device backlog at which a batch spills to the least-loaded lane
     mesh_spill_depth: int = field(default_factory=_default_mesh_spill_depth)
+    # megabatch backlog trigger: fuse when queued same-bucket work >=
+    # mesh width x k (0 = full-batch-only, the pre-trigger behavior)
+    megabatch_backlog_k: int = field(default_factory=_default_megabatch_backlog_k)
     # per-lane engine injection (tests/bench: doubles, shared engines);
     # None = one device-pinned WitnessEngine per lane
     mesh_engine_factory: Optional[Callable] = None
@@ -369,7 +382,11 @@ def batch_record_from_handle(
     if total is not None and miss is not None:
         record["cache_hits"] = total - miss
         record["cache_misses"] = n_novel if n_novel is not None else miss
-    if getattr(handle, "device", None) is not None:
+    if getattr(handle, "resident", None) is not None:
+        # device-resident route: verdict + novel hashing on device
+        # against the persistent intern table (ops/witness_resident.py)
+        record["backend"] = "resident"
+    elif getattr(handle, "device", None) is not None:
         record["backend"] = "device"
     elif n_novel if n_novel is not None else miss:
         record["backend"] = "native"
@@ -471,6 +488,7 @@ class VerificationScheduler:
                 spill_depth=self.config.mesh_spill_depth,
                 dispatch=self.config.mesh_dispatch,
                 max_batch=self._max_batch,
+                backlog_k=self.config.megabatch_backlog_k,
                 engine=engine,
                 engine_factory=self.config.mesh_engine_factory,
                 on_done=self._mesh_done,
@@ -520,6 +538,9 @@ class VerificationScheduler:
             # full single-bucket batches sent as whole-mesh fused calls
             "mesh_batches": 0,
             "megabatches": 0,
+            # megabatches fired by the backlog-depth trigger (queued
+            # same-bucket work >= mesh width x k) rather than a full batch
+            "megabatch_backlog_triggers": 0,
             "rejected": 0,
             # QoS: backfill jobs evicted to admit head-of-chain work, and
             # how often the adaptive policy changed the assembly wait
@@ -1439,7 +1460,24 @@ class VerificationScheduler:
                 "chaos drill: PHANT_SCHED_CHAOS_CRASH=1 induced executor crash"
             )
         pool = self._pool
-        if pool.megabatch_wanted(len(jobs)):
+        # backlog-depth trigger input: the same-bucket jobs STILL queued
+        # after assembly (assembly drained the bucket up to max_batch, so
+        # a non-zero leftover means sustained same-shape pressure). The
+        # queue walk holds the global lock — only pay it when the
+        # trigger can actually consume it (megabatch mode, k > 0), never
+        # on the default affinity hot path.
+        backlog = 0
+        if pool.backlog_wanted():
+            bucket = jobs[0].bucket
+            with self._lock:
+                backlog = sum(
+                    1
+                    for lane in self._lanes.values()
+                    for qj in lane
+                    if qj.kind == _WITNESS and qj.bucket == bucket
+                )
+        why = pool.megabatch_wanted(len(jobs), backlog)
+        if why:
             from phant_tpu.serving.mesh_exec import MegabatchUnsupported
 
             try:
@@ -1449,6 +1487,13 @@ class VerificationScheduler:
             else:
                 with self._lock:
                     self.stats["megabatches"] += 1
+                    if why == "backlog":
+                        self.stats["megabatch_backlog_triggers"] += 1
+                if why == "backlog":
+                    # fusion engaged by sustained overload, not a full
+                    # batch — the trigger the operator tunes with
+                    # --sched-megabatch-backlog-k
+                    metrics.count("sched.megabatch_backlog_triggers")
                 self._finish_witness_jobs(jobs, verdicts, record, picked)
                 with self._lock:
                     self._drop_inflight_locked(batch_id)

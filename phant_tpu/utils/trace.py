@@ -160,6 +160,16 @@ METRIC_HELP: Dict[str, str] = {
     "witness_engine.pack": "Pack stage: host batch assembly + lock-held intern-table scan (begin_batch)",
     "witness_engine.dispatch": "Dispatch stage: device keccak enqueue of the novel nodes, no host sync (begin_batch)",
     "witness_engine.resolve": "Resolve stage: digest readback/hash outside the lock + commit + linkage join (resolve_batch)",
+    # cache_hit_rate vs trie_depth (PHANT_DEPTH_HIST=1): per-depth scan
+    # outcome, labels "0".."6", "7+", "u" (unreachable from the root)
+    "witness_engine.depth_hits": "Witness-node cache hits by trie depth under the block root (depth-skewed reuse, PAPERS.md 2408.14217)",
+    "witness_engine.depth_misses": "Witness-node cache misses (novel nodes) by trie depth under the block root",
+    # device-resident intern table (ops/witness_resident.py)
+    "witness_resident.rows": "Rows resident on device (digest + child-ref rows, persistent across batches)",
+    "witness_resident.uploaded_nodes": "Truly-novel nodes uploaded to the resident table (after the host prune)",
+    "witness_resident.uploaded_bytes": "Truly-novel bytes uploaded to the resident table — the ONLY recurring h2d payload of the resident route",
+    "witness_resident.dispatch": "Resident dispatch phase: prune + row assignment + update/verdict enqueue, no host sync",
+    "witness_resident.resolve": "Resident resolve phase: verdict (1 B/block) + core-novel digest readback (the honest sync)",
     # continuous-batching scheduler (phant_tpu/serving/)
     "sched.queue_depth": "Verification requests currently in the scheduler admission queue (all lanes)",
     "sched.tenant_queue_depth": "Witness requests currently queued, by tenant lane",
@@ -183,6 +193,7 @@ METRIC_HELP: Dict[str, str] = {
     "sched.device_dispatch": "Witness batches routed to a mesh device lane (device='mesh' = whole-mesh megabatch), by device",
     "sched.device_stall": "Scheduler waits for a free mesh lane slot (every device at its bound)",
     "sched.mesh_megabatches": "Full single-bucket batches dispatched as one whole-mesh sharded fused kernel call",
+    "sched.megabatch_backlog_triggers": "Megabatches fired by the backlog-depth trigger (queued same-bucket work >= mesh width x k) rather than a full batch",
     # observability layer (phant_tpu/obs/)
     "sched.watchdog_stalls": "Executor stalls detected by the obs watchdog (in-flight batch past its deadline)",
     "flight.dumps": "Flight-recorder postmortem dumps written, by trigger reason",

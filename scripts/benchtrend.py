@@ -75,11 +75,18 @@ def _direction(key: str) -> Optional[str]:
         or key.endswith("_rps")
         or key.endswith("_mbps")
         or key.endswith("_speedup")
+        or key.endswith("_vs_baseline")
         or key == "value"
     ):
         # _rps: the serving_load goodput/capacity keys (requests/sec);
         # _speedup: the serving_mesh scaling ratio (round 7) — a shrinking
-        # best-devices/one-device ratio is a real scaling regression
+        # best-devices/one-device ratio is a real scaling regression;
+        # _slope_blocks_per_sec (round 8, witness_resident): the
+        # RTT-insensitive chained-dispatch rates are covered by the
+        # _per_sec suffix — pinned by test so a suffix rework cannot
+        # silently drop the headline metric's direction; _vs_baseline:
+        # the slope/baseline ratio itself (a shrinking ratio is the
+        # headline regressing even if both rates moved)
         return "up"
     if _PCTL_RE.search(key):
         return "down"

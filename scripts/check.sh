@@ -32,6 +32,7 @@ DEVICE_GROUPS=(
   tests/test_mpt_jax.py
   tests/test_witness_jax.py
   tests/test_witness_fused.py
+  tests/test_witness_resident.py
   tests/test_parallel.py
   tests/test_graft_entry.py
 )

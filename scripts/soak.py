@@ -289,8 +289,9 @@ def _pipeline_phase() -> int:
 
     outs = {}
     for depth in (1, 2):
+        eng = WitnessEngine()
         with VerificationScheduler(
-            engine=WitnessEngine(),
+            engine=eng,
             config=SchedulerConfig(
                 max_batch=16, max_wait_ms=10.0, queue_depth=4096,
                 pipeline_depth=depth,
@@ -300,6 +301,11 @@ def _pipeline_phase() -> int:
             st = s.stats_snapshot()
             if depth == 2 and st["pipelined_batches"] < 1:
                 failures.append(f"depth-2 soak never pipelined: {st}")
+        # explicit release between passes: a fresh engine per depth
+        # re-seeds the HOST tables, but a device-resident table's arrays
+        # would linger until GC — the depth-2 pass must not run against
+        # a box still holding depth-1's device memory
+        eng.reset()
     if not (outs[1] == outs[2]).all() or not outs[1].all():
         failures.append("depth-2 verdicts diverge from depth-1")
 

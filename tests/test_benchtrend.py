@@ -315,3 +315,38 @@ def test_json_output_parses(tmp_path):
     )
     rec = json.loads(out.stdout)
     assert "rows" in rec and "flags" in rec
+
+
+def test_witness_resident_key_directions():
+    """Round-8 `witness_resident` section keys: the slope-timed chained
+    rates (`_slope_blocks_per_sec` — THE headline metric on real
+    accelerators) and the slope/baseline ratio are higher-is-better;
+    byte-accounting and shape echoes are informational. Pinned so a
+    direction-suffix rework cannot silently drop the headline metric."""
+    d = benchtrend._direction
+    assert d("witness_fused_resident_slope_blocks_per_sec") == "up"
+    assert d("witness_resident_first_blocks_per_sec") == "up"
+    assert d("witness_resident_steady_blocks_per_sec") == "up"
+    assert d("witness_resident_slope_vs_baseline") == "up"
+    assert d("witness_resident_local_projection_blocks_per_sec") == "up"
+    # echoes/accounting: never flagged as perf regressions
+    assert d("witness_resident_blocks") is None
+    assert d("resident_novel_bytes_per_block_steady") is None
+    assert d("resident_rows") is None
+    assert d("witness_bytes_per_block") is None
+
+
+def test_witness_resident_slope_regression_flags(tmp_path):
+    """A collapsed resident slope rate must flag from the committed
+    rounds onward (it is the artifact's headline on real hardware)."""
+    for n, rate in enumerate([5200.0, 5400.0, 5100.0], start=1):
+        _write_round(
+            tmp_path, n, {"witness_fused_resident_slope_blocks_per_sec": rate}
+        )
+    _write_round(
+        tmp_path, 4, {"witness_fused_resident_slope_blocks_per_sec": 900.0}
+    )
+    rows, flags = benchtrend.analyze(str(tmp_path), 0.4, 2)
+    assert any(
+        "witness_fused_resident_slope_blocks_per_sec" in f for f in flags
+    )

@@ -893,3 +893,39 @@ def test_metrics_lint_shim_stays_green():
     )
     assert proc.returncode == 0, proc.stdout + proc.stderr
     assert "[metrics-lint] ok" in proc.stdout
+
+
+def test_resident_dispatch_is_in_hostsync_scope(mutated_tree, monkeypatch):
+    """The resident-table hot path (PR 8) is HOSTSYNC-scoped: the whole
+    point of the route is zero host syncs at dispatch, so a reintroduced
+    readback in the resident scan/assign/enqueue path must turn the gate
+    red."""
+    from phant_tpu.analysis.rules.hostsync import DEFAULT_ENTRIES
+
+    assert (
+        "phant_tpu.ops.witness_resident.ResidentTable.dispatch"
+        in DEFAULT_ENTRIES
+    )
+    assert (
+        "phant_tpu.ops.witness_engine.WitnessEngine.begin_batch"
+        in DEFAULT_ENTRIES
+    )
+    p = mutated_tree / "phant_tpu" / "ops" / "witness_resident.py"
+    src = p.read_text()
+    mutated = src.replace(
+        "        h.uploaded_nodes = len(cand)\n",
+        "        _sync = h.verdict_out.sum().item()\n"
+        "        h.uploaded_nodes = len(cand)\n",
+        1,
+    )
+    assert mutated != src
+    p.write_text(mutated)
+    res = _analyze_repo_tree(mutated_tree, monkeypatch)
+    hits = [
+        f
+        for f in res.new
+        if f.rule == "HOSTSYNC"
+        and ".item()" in f.message
+        and "witness_resident" in f.path
+    ]
+    assert hits, [f.render() for f in res.new]

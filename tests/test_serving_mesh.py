@@ -537,3 +537,58 @@ def test_cli_mesh_flags():
         st = s.state()
         assert st["mesh"]["devices"] == 4
         assert st["mesh"]["dispatch"] == "megabatch"
+
+
+def test_megabatch_backlog_trigger_fires_below_full_batch():
+    """`--sched-megabatch-backlog-k`: a single-bucket batch far below
+    max_batch takes the whole-mesh fused path once queued same-bucket
+    work reaches mesh width x k — fusion under sustained overload
+    without sizing max_batch — and the firing is counted separately
+    (stats + metric)."""
+    wits = _same_bucket_witnesses(16)
+    wits[5] = (b"\x00" * 32, wits[5][1])  # corrupted: must stay False
+    want = np.asarray(WitnessEngine().verify_batch(wits))
+    snap0 = metrics.snapshot()["counters"].get(
+        "sched.megabatch_backlog_triggers", 0
+    )
+    with _mesh_sched(
+        2,
+        max_batch=64,  # never filled: only the backlog trigger can fuse
+        max_wait_ms=500.0,
+        adaptive_wait=False,
+        mesh_dispatch="megabatch",
+        megabatch_backlog_k=1,
+    ) as s:
+        got = s.verify_many(wits)
+        st = s.stats_snapshot()
+    assert (got == want).all()
+    assert not got[5]
+    assert st["megabatches"] >= 1
+    assert st["megabatch_backlog_triggers"] >= 1
+    assert (
+        metrics.snapshot()["counters"].get("sched.megabatch_backlog_triggers", 0)
+        > snap0
+    )
+
+
+def test_megabatch_backlog_trigger_default_off():
+    """k=0 (the default) keeps the full-batch-only behavior: the same
+    under-full single-bucket stream routes by affinity, zero megabatches."""
+    wits = _same_bucket_witnesses(16)
+    with _mesh_sched(
+        2,
+        max_batch=64,
+        max_wait_ms=500.0,
+        adaptive_wait=False,
+        mesh_dispatch="megabatch",
+    ) as s:
+        got = s.verify_many(wits)
+        st = s.stats_snapshot()
+    assert got.all()
+    assert st["megabatches"] == 0
+    assert st["megabatch_backlog_triggers"] == 0
+
+
+def test_megabatch_backlog_k_cli_flag():
+    args = build_parser().parse_args(["--sched-megabatch-backlog-k", "3"])
+    assert args.sched_megabatch_backlog_k == 3
