@@ -85,6 +85,14 @@ root. Verifiers measured on the SAME span:
     `witness_fused_resident_slope_blocks_per_sec` — the RTT-insensitive
     slope-timed chained rate that becomes the artifact's value /
     vs_baseline on a real accelerator (the >=10x driver capture).
+  * witness_stream (device section) — streaming witness ingestion
+    (round 9): (a) the 4-stage pipeline's prefetch A/B through the
+    scheduler at depth 2 (median paired overlap vs the A/A noise bar,
+    plus `witness_stream_prefetch_hidden_pct` — the fraction of the
+    decode + pre-scan the executor never waited for, from the phase
+    metrics); (b) the over-cap replay A/B of flat-flush vs depth-tiered
+    eviction (steady-state hit rates, verdict identity asserted
+    in-section). XLA-CPU is the device proxy on CPU-only runs.
 
 The cold fused device kernel (everything incl. RLP ref parsing on device,
 ops/witness_jax.py witness_verify_fused) is timed honestly per batch, and
@@ -2082,6 +2090,243 @@ def sec_replay_device() -> dict:
     return _replay_variants("tpu")
 
 
+def sec_witness_stream() -> dict:
+    """Streaming witness ingestion (PR 9), the two coupled claims.
+
+    (a) PREFETCH OVERLAP: the same span through the serving scheduler at
+    pipeline depth 2 with the 4th (prefetch) stage ON vs OFF, on the
+    device-routed engine (XLA-CPU proxy on CPU-only runs). The box
+    swings single runs ±30%, so the headline is the MEDIAN of PAIRED
+    interleaved runs published next to the same-statistic A/A (on vs on)
+    noise bar — the win claim is `witness_stream_prefetch_overlap_pct >
+    witness_stream_noise_aa_pct`, never a raw delta. The overlap AUDIT
+    comes from the phase metrics: `witness_engine.prefetch` is what the
+    worker spent decoding + pre-scanning, `sched.prefetch_wait` is the
+    part the executor actually had to wait for —
+    `witness_stream_prefetch_hidden_pct` = the fraction that hid under
+    dispatch/resolve (the >=80% acceptance surface; on this 2-core box
+    the proxy's "device" compute shares the host cores, so the hidden
+    fraction is the honest claim and the wall-clock overlap is bounded
+    by the host-side fraction of a batch).
+
+    (b) TIERED EVICTION: an over-cap forward replay of the PR 8
+    depth-skew span (static trie, rotating account picks — the
+    reuse-dominated regime 2408.14217 predicts, novel bytes/block -> 0)
+    under flat-flush vs depth-tiered eviction (PHANT_PIN_DEPTH tiers
+    pinned across generation flushes; the pinned set liveness-prunes at
+    each flush, and the steady state is measured over the span's second
+    half). Verdict identity — corrupt witnesses included — is asserted
+    IN-SECTION against an uncapped oracle; the committed claim is the
+    steady-state hit-rate margin (`witness_stream_tiered_hit_rate` vs
+    `witness_stream_flat_hit_rate` — benchtrend trend-gates both, plus
+    the hidden/overlap keys)."""
+    import jax
+
+    from phant_tpu.backend import set_crypto_backend
+    from phant_tpu.ops.witness_engine import WitnessEngine
+    from phant_tpu.serving.scheduler import (
+        SchedulerConfig,
+        VerificationScheduler,
+    )
+    from phant_tpu.utils.trace import metrics as _m
+
+    warm, span = _witness_chain()
+    n_blocks = len(span)
+    out: dict = {
+        "witness_stream_backend": jax.devices()[0].platform,
+        "witness_stream_blocks": n_blocks,
+    }
+    if jax.default_backend() == "cpu":
+        os.environ["PHANT_ALLOW_JAX_CPU"] = "1"
+        out["witness_stream_proxy"] = "xla-cpu"
+    mb = int(os.environ.get("PHANT_BENCH_STREAM_BATCH", "16"))
+    pairs = int(os.environ.get("PHANT_BENCH_STREAM_PAIRS", "5"))
+    wb = int(os.environ.get("PHANT_BENCH_ENGINE_BATCH", "256"))
+
+    set_crypto_backend("cpu")
+    oracle = WitnessEngine()
+    for i in range(0, len(warm), wb):
+        assert oracle.verify_batch(warm[i : i + wb]).all()
+    want = np.asarray(oracle.verify_batch(span))
+
+    hidden: list = []
+
+    def one(prefetch: bool, check: bool = False) -> float:
+        set_crypto_backend("cpu")  # warm the cache on the fast native route
+        eng = WitnessEngine(device_batch_floor=0)
+        for i in range(0, len(warm), wb):
+            assert eng.verify_batch(warm[i : i + wb]).all()
+        set_crypto_backend("tpu")  # timed span: device-routed
+        t_before = _m.snapshot()["timers"]
+        try:
+            with VerificationScheduler(
+                engine=eng,
+                config=SchedulerConfig(
+                    max_batch=mb, max_wait_ms=100.0,
+                    queue_depth=n_blocks + 1, pipeline_depth=2,
+                    prefetch=prefetch,
+                ),
+            ) as s:
+                t0 = time.perf_counter()
+                got = s.verify_many(span)
+                dt = time.perf_counter() - t0
+                st = s.stats_snapshot()
+            if prefetch:
+                assert st["prefetched_batches"] >= 1, st
+                t_after = _m.snapshot()["timers"]
+
+                def delta(name):
+                    return t_after.get(name, {}).get("total_s", 0.0) - (
+                        t_before.get(name, {}).get("total_s", 0.0)
+                    )
+
+                pf, wait = delta("witness_engine.prefetch"), delta(
+                    "sched.prefetch_wait"
+                )
+                if pf > 0 and not check:
+                    # the compile-warm run is excluded: its 10s-scale XLA
+                    # compile under dispatch gives the worker unlimited
+                    # lead and would bias the hidden fraction UP
+                    hidden.append(max(0.0, 1.0 - wait / pf))
+            if check:
+                assert (got == want).all(), (
+                    "prefetched verdicts diverge from direct verify_batch"
+                )
+            else:
+                assert got.all()
+            return dt
+        finally:
+            set_crypto_backend("cpu")
+
+    one(True, check=True)  # compile warm + byte-identity check, discarded
+    d_off: list = []
+    d_on: list = []
+    overlaps: list = []
+    aa: list = []
+    for _ in range(pairs):
+        a = one(False)
+        b_on = one(True)
+        a_on2 = one(True)  # the A/A twin measures the box, not the code
+        d_off.append(a)
+        # the twin feeds ONLY the noise bar: committed on/off rates take
+        # min() over EQUAL sample counts (2x on-draws would bias the
+        # on-key's minimum down on a noisy box with zero real speedup)
+        d_on.append(b_on)
+        overlaps.append(1.0 - b_on / a)
+        aa.append(abs(1.0 - a_on2 / b_on))
+    overlaps.sort()
+    aa.sort()
+    hidden.sort()
+    out.update(
+        {
+            "witness_stream_prefetch_off_blocks_per_sec": round(
+                n_blocks / min(d_off), 2
+            ),
+            "witness_stream_prefetch_on_blocks_per_sec": round(
+                n_blocks / min(d_on), 2
+            ),
+            "witness_stream_prefetch_overlap_pct": round(
+                overlaps[len(overlaps) // 2] * 100, 1
+            ),
+            "witness_stream_noise_aa_pct": round(aa[len(aa) // 2] * 100, 1),
+            "witness_stream_prefetch_hidden_pct": round(
+                hidden[len(hidden) // 2] * 100, 1
+            )
+            if hidden
+            else None,
+            "witness_stream_batch": mb,
+            "witness_stream_pairs": pairs,
+        }
+    )
+    _bank(out)
+
+    # -- (b) flat vs depth-tiered eviction on the over-cap replay ----------
+    # The eviction claim lives in the REUSE-DOMINATED regime the paper's
+    # trie analysis (2408.14217) predicts and PR 8 measured (novel bytes
+    # per block -> ~0): a depth-skewed span over a STATIC trie with
+    # rotating account picks — the PR 8 depth-histogram workload. Part
+    # (a)'s churning chain stays the prefetch-overlap workload; under
+    # heavy per-block writes the working set churns and no eviction
+    # policy can manufacture reuse that isn't there.
+    skew = _cached(
+        "wskew_256_16384_32",
+        lambda: build_witness_chain(
+            256,
+            trie_size=16384,
+            reads=32,
+            writes=0,
+            storage_slots=2048,
+            storage_reads_per_block=8,
+        ),
+    )
+    # corruption classes ride mid-span so the identity assert has teeth
+    # (bad witnesses must FAIL identically under both policies)
+    sroot, snodes = skew[40]
+    skew = (
+        skew[:80]
+        + [(b"\x00" * 32, list(snodes)), (sroot, [])]
+        + skew[80:]
+    )
+    uniq = len({n for _r, ns in skew for n in ns})
+    cap = max(48, uniq // 3)
+    # pin budget: half the cap (the conservative engine default,
+    # max_nodes // 8, under-pins the depth<=2 tier at bench shapes —
+    # the committed knob is part of the claim)
+    pin_budget = cap // 2
+    chunk = max(2, mb // 4)
+    want_b = [bool(v) for v in WitnessEngine().verify_batch(skew)]
+    assert not all(want_b) and any(want_b), "corruptions must fail"
+
+    # steady state is measured FORWARD: the span's second half, once the
+    # tables warmed and over-cap flushes cycle. The skew span serves one
+    # state root throughout (mainnet steady state at the head: verify
+    # traffic clusters on recent roots), so the pin tracker's flush-time
+    # liveness prune keeps the live shallow tier while a flat flush
+    # throws it away with everything else.
+    half = (len(skew) // (2 * chunk)) * chunk
+
+    def measured_replay(eng) -> tuple:
+        verdicts: list = []
+        for i in range(0, half, chunk):
+            verdicts.extend(
+                np.asarray(eng.verify_batch(skew[i : i + chunk])).tolist()
+            )
+        h0, m0 = eng.stats["hits"], eng.stats["hashed"]
+        for i in range(half, len(skew), chunk):
+            verdicts.extend(
+                np.asarray(eng.verify_batch(skew[i : i + chunk])).tolist()
+            )
+        dh = eng.stats["hits"] - h0
+        dm = eng.stats["hashed"] - m0
+        return verdicts, dh / max(1, dh + dm)
+
+    flat = WitnessEngine(max_nodes=cap, tiered_evict=False)
+    tier = WitnessEngine(
+        max_nodes=cap, tiered_evict=True, pin_budget=pin_budget
+    )
+    vf, rate_flat = measured_replay(flat)
+    vt, rate_tier = measured_replay(tier)
+    assert vf == vt == want_b, "tiered eviction changed a verdict"
+    frag_b = {
+        "witness_stream_cap": cap,
+        "witness_stream_pin_budget": pin_budget,
+        "witness_stream_unique_nodes": uniq,
+        "witness_stream_flat_hit_rate": round(rate_flat, 4),
+        "witness_stream_tiered_hit_rate": round(rate_tier, 4),
+        "witness_stream_tiered_hit_gain_pct": round(
+            (rate_tier - rate_flat) * 100, 2
+        ),
+        "witness_stream_flat_evictions": flat.stats["evictions"],
+        "witness_stream_tiered_evictions": tier.stats["evictions"],
+        "witness_stream_pinned_retained": tier.stats.get(
+            "pinned_retained", 0
+        ),
+    }
+    out.update(frag_b)
+    _bank(frag_b)
+    return out
+
+
 # priority order matters: when the tunnel window is short, the headline
 # engine number and the GLV proof come first
 _CPU_SECTIONS = {
@@ -2102,6 +2347,7 @@ _DEVICE_SECTIONS = {
     "engine": sec_engine_device,
     "witness_resident": sec_witness_resident,
     "engine_pipeline": sec_engine_pipeline,
+    "witness_stream": sec_witness_stream,
     "keccak": sec_keccak_device,
     "ecrecover": sec_ecrecover_device,
     "replay": sec_replay_device,
@@ -2112,6 +2358,7 @@ _DEVICE_BUDGET = {
     "engine": 700,
     "witness_resident": 420,
     "engine_pipeline": 420,
+    "witness_stream": 420,
     "ecrecover": 900,
     "replay": 700,
     "state_root": 480,
@@ -2249,7 +2496,8 @@ def main() -> None:
 
     only = os.environ.get("PHANT_BENCH_ONLY", "")
     selected = [s.strip() for s in only.split(",") if s.strip()] or (
-        list(_CPU_SECTIONS) + ["witness_resident", "engine_pipeline"]
+        list(_CPU_SECTIONS)
+        + ["witness_resident", "engine_pipeline", "witness_stream"]
     )
     # legacy per-section kill switches stay honored
     for flag, sec in (
@@ -2400,7 +2648,13 @@ def main() -> None:
         # acceptance surface, and their witness-shape compiles are
         # seconds, not the minutes that keep engine/state_root device
         # variants out of the inline list
-        for name in ("witness_resident", "engine_pipeline", "replay", "keccak"):
+        for name in (
+            "witness_resident",
+            "engine_pipeline",
+            "witness_stream",
+            "replay",
+            "keccak",
+        ):
             if name not in selected:
                 continue
             if name == "keccak" and os.environ.get("PHANT_BENCH_KECCAK", "1") in ("0", ""):

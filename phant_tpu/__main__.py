@@ -118,6 +118,18 @@ def build_parser() -> argparse.ArgumentParser:
         "digest resolve of batch N; 1 serializes (the pre-pipeline "
         "behavior). Default: PHANT_SCHED_PIPELINE_DEPTH or 2",
     )
+    p.add_argument(
+        "--sched-prefetch",
+        type=int,
+        choices=(0, 1),
+        default=None,
+        help="4th pipeline stage: a prefetch worker runs batch N+1's "
+        "witness decode + intern-table novelty pre-scan while batch N "
+        "is in dispatch/resolve (on whenever the pipeline depth is >= "
+        "2; the pre-scan is advisory — pack's lock-held re-check stays "
+        "the authoritative commit). 0 pins the 3-stage pipeline. "
+        "Default: PHANT_SCHED_PREFETCH or 1",
+    )
     # mesh-sharded dispatch (phant_tpu/serving/mesh_exec.py): one
     # pipelined executor per device, each with a device-pinned engine
     p.add_argument(
@@ -257,6 +269,8 @@ def main(argv=None) -> int:
     )
     if args.sched_pipeline_depth is not None:
         sched_kwargs["pipeline_depth"] = args.sched_pipeline_depth
+    if args.sched_prefetch is not None:
+        sched_kwargs["prefetch"] = bool(args.sched_prefetch)
     # mesh dispatch: a flag wins over its PHANT_SCHED_MESH* env default
     if args.sched_mesh is not None:
         sched_kwargs["mesh_devices"] = args.sched_mesh
@@ -305,6 +319,23 @@ def main(argv=None) -> int:
         raise KeyboardInterrupt
 
     signal.signal(signal.SIGTERM, _on_sigterm)
+    # SIGINT root cause of the mesh-e2e "shutdown hang" (PR 9): a server
+    # launched as a shell background job (`python -m phant_tpu ... &` in a
+    # non-interactive shell) inherits SIGINT=SIG_IGN per POSIX, and
+    # CPython honors an inherited SIG_IGN by never installing the
+    # KeyboardInterrupt handler — so ^C/`kill -INT` is silently ignored
+    # FOREVER (faulthandler showed the main thread idle in selector.poll,
+    # every scheduler/lane thread parked in its timed wait; nothing was
+    # actually wedged). Install the handler explicitly, the same way
+    # long-running daemons that still want graceful-stop semantics do.
+    def _on_sigint(_signum, _frame):
+        # a second ^C mid-drain must not abort shutdown (it lands inside
+        # scheduler.shutdown's joins and leaks the socket, rc 130):
+        # the first SIGINT starts the drain, later ones are ignored
+        signal.signal(signal.SIGINT, signal.SIG_IGN)
+        raise KeyboardInterrupt
+
+    signal.signal(signal.SIGINT, _on_sigint)
 
     try:
         # --trace-logdir wraps the whole serving run in the JAX profiler

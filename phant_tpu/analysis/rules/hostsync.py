@@ -53,6 +53,13 @@ DEFAULT_ENTRIES: Tuple[str, ...] = (
     # the architecture (the resolve stage's honest syncs are annotated)
     "phant_tpu.ops.witness_engine.WitnessEngine.begin_batch",
     "phant_tpu.ops.witness_resident.ResidentTable.dispatch",
+    # streaming witness ingestion (PR 9): the prefetch stage exists to
+    # take work OFF the serving critical path — the engine pre-scan and
+    # the scheduler's prefetch worker must never pull a device scalar
+    # (a sync there re-serializes the 4th stage against the device and
+    # silently turns the overlap win into a stall)
+    "phant_tpu.ops.witness_engine.WitnessEngine.prefetch_batch",
+    "phant_tpu.serving.scheduler.VerificationScheduler._prefetch_run",
 )
 
 _SCALAR_BUILTINS = ("int", "bool", "float")

@@ -114,6 +114,7 @@ class BatchHandle:
         "witnesses",    # python core linkage join
         "device",       # keccak_jax.DeviceDigests when dispatched async
         "resident",     # witness_resident.ResidentBatch on the resident route
+        "ref_hint",     # python core: prefetch-decoded bytes -> child refs
         "resolved",
     )
 
@@ -236,6 +237,217 @@ class _DepthStats:
             metrics.count("witness_engine.depth_misses", c, depth=lbl)
 
 
+class _PinTracker:
+    """Shallow-node classifier behind depth-TIERED eviction (PR 9).
+
+    The PR 8 depth histogram measured what PAPERS.md 2408.14217 predicts:
+    cross-block reuse is depth-skewed — depth-0 nodes hit > 90%, depth 1
+    > 75%, and the rate falls monotonically toward the leaves. A flat
+    generation flush therefore throws away exactly the rows most likely
+    to be needed again. This tracker identifies the shallow tier so the
+    flush can PIN it across generations, at (near) zero hot-path cost:
+
+      * roots are depth-0 DIGESTS by definition — noted per batch from
+        the witness tuples, no hashing;
+      * when a batch's novel nodes surface with their digests (every
+        commit path already has both), a novel whose digest is a known
+        shallow digest is pinned, and its child references (one RLP ref
+        scan of that node only) become shallow digests one level deeper.
+
+    Hit nodes cost NOTHING (no per-occurrence work — the deliberate
+    contrast with the PHANT_DEPTH_HIST per-batch BFS, which stays an
+    opt-in measurement tool). Classification is conservative: a shallow
+    node committed before its parent's digest was known is simply not
+    pinned until it next churns — a missed pin is a perf miss, never a
+    correctness issue (eviction soundness never depended on WHICH rows
+    survive).
+
+    Budgets: `budget` bounds the pinned set; at flush time the snapshot
+    is shallow-FIRST (per-depth allocation falls out of the live
+    classification — all of depth 0, then depth 1, ... until the budget),
+    because the measured hit rate is monotone in depth.
+
+    Staleness: pins age out at FLUSH time, never on the hot path. Each
+    generation records the root digests it actually served (from the
+    same per-batch note_roots); the flush snapshot keeps only pins
+    reachable from the last TWO generations' roots through the pinned
+    nodes' own child refs (one RLP ref scan per pinned node, flush-time
+    cost — two windows because a generation can be arbitrarily short
+    under a novel-filler burst, and one root-less window must not kill
+    a live pin). Without the prune the budget would saturate with the
+    first generations' shallow nodes and a churning trie — the real
+    workload — would re-commit an increasingly dead set forever."""
+
+    __slots__ = (
+        "pin_depth",
+        "budget",
+        "_shallow",
+        "_pinned",
+        "_recent_roots",
+        "_prev_roots",
+    )
+
+    def __init__(self, pin_depth: int, budget: int):
+        self.pin_depth = max(0, pin_depth)
+        self.budget = max(1, budget)
+        # digest -> min observed depth (only depths <= pin_depth kept)
+        self._shallow: Dict[bytes, int] = {}
+        # node bytes -> (depth, digest): the pin candidates
+        self._pinned: Dict[bytes, Tuple[int, bytes]] = {}
+        # root digests served in the current / previous generation: the
+        # liveness evidence the flush-time prune walks from. Two windows,
+        # not one — a generation can be arbitrarily short (a burst of
+        # novel filler flushes back-to-back), and a pin must survive a
+        # single root-less window before it counts as dead
+        self._recent_roots: set = set()
+        self._prev_roots: set = set()
+
+    def _shallow_cap(self) -> int:
+        # bounded advisory state: 17 refs/node over the pinned budget,
+        # plus root-digest churn headroom
+        return max(4096, self.budget * 17)
+
+    def note_roots(self, roots) -> None:
+        sh = self._shallow
+        if len(sh) > self._shallow_cap():
+            # advisory overflow: drop and rebuild from live traffic
+            # (pinned entries keep their own digests)
+            sh.clear()
+        rr = self._recent_roots
+        if len(rr) > self._shallow_cap():
+            rr.clear()  # same bounded-advisory-state contract as _shallow
+        for r in roots:
+            if len(r) == 32:
+                rr.add(r)
+                if sh.get(r, 1) > 0:
+                    sh[r] = 0
+
+    def note_novel(self, novel: Sequence[bytes], digests: Sequence[bytes]) -> None:
+        """Classify one commit's novel nodes. Runs pin_depth+1 passes so
+        a parent and child landing in the same batch classify regardless
+        of their order in the novel list (novel lists are tiny in the
+        steady state — reuse makes them so)."""
+        sh, pinned = self._shallow, self._pinned
+        pin_depth, budget = self.pin_depth, self.budget
+        for _ in range(pin_depth + 1):
+            changed = False
+            for nb, dg in zip(novel, digests):
+                d = sh.get(dg)
+                if d is None or d > pin_depth:
+                    continue
+                cur = pinned.get(nb)
+                if cur is not None and cur[0] <= d:
+                    continue
+                if cur is None and len(pinned) >= budget:
+                    continue  # full: only min-depth updates of existing pins
+                pinned[nb] = (d, dg)
+                changed = True
+                if d < pin_depth and len(sh) < self._shallow_cap():
+                    for r in _extract_ref_digests(nb):
+                        if sh.get(r, pin_depth + 1) > d + 1:
+                            sh[r] = d + 1
+            if not changed:
+                break
+
+    def pinned_snapshot(self) -> List[Tuple[bytes, bytes, int]]:
+        """[(node bytes, digest, depth)] shallow-first within the budget
+        (ties keep insertion order — older shallow nodes first). Called
+        at FLUSH time, so it first prunes stale pins and opens the next
+        generation's liveness window."""
+        self._prune_stale()
+        items = sorted(self._pinned.items(), key=lambda kv: kv[1][0])
+        return [(nb, dg, d) for nb, (d, dg) in items[: self.budget]]
+
+    def _prune_stale(self) -> None:
+        """Keep only pins reachable from a root served THIS generation,
+        walking child refs through the pinned nodes themselves (depths
+        re-derive along the walk). Conservative in the documented
+        direction: a live deep pin whose parent never pinned is dropped
+        and re-classifies when it next churns — a perf miss, never a
+        correctness issue. Runs once per generation flush, never on the
+        hot path."""
+        pinned = self._pinned
+        rr = self._recent_roots | self._prev_roots
+        self._prev_roots = self._recent_roots
+        self._recent_roots = set()
+        if not pinned:
+            return
+        by_digest = {dg: nb for nb, (_d, dg) in pinned.items()}
+        live: Dict[bytes, int] = {}
+        frontier = [r for r in rr if r in by_digest]
+        for r in frontier:
+            live[r] = 0
+        depth = 0
+        while frontier and depth < self.pin_depth:
+            nxt = []
+            for dg in frontier:
+                for r in _extract_ref_digests(by_digest[dg]):
+                    if r in by_digest and r not in live:
+                        live[r] = depth + 1
+                        nxt.append(r)
+            frontier = nxt
+            depth += 1
+        self._pinned = {
+            nb: (live[dg], dg)
+            for nb, (_d, dg) in pinned.items()
+            if dg in live
+        }
+
+    def per_depth(self) -> Dict[int, int]:
+        out: Dict[int, int] = {}
+        for _nb, (d, _dg) in self._pinned.items():
+            out[d] = out.get(d, 0) + 1
+        return out
+
+    def flush(self) -> None:
+        self._shallow.clear()
+        self._pinned.clear()
+        self._recent_roots.clear()
+        self._prev_roots.clear()
+
+
+class PrefetchPlan:
+    """Output of `WitnessEngine.prefetch_batch` — everything the PACK
+    stage would otherwise compute on the serving critical path: the host
+    batch assembly, an ADVISORY novelty pre-scan against the committed
+    tables, the decoded child references of the candidate novels, and
+    pre-filled staging leases (host pack blob / device dispatch blob).
+
+    Staleness contract: the plan is advisory end to end. begin_batch's
+    lock-held scan remains the authoritative commit — a plan whose
+    candidate set no longer matches (a concurrent batch committed some
+    of them, a generation flushed) is simply dropped, which costs the
+    perf win and nothing else. `release()` returns unconsumed staging
+    leases to the pool (idempotent; begin_batch calls it, crash paths
+    may call it again)."""
+
+    __slots__ = (
+        "witnesses",
+        "all_nodes",
+        "counts",
+        "novel",      # candidate-novel bytes (advisory, dedup'd)
+        "refs",       # python core: node bytes -> child-ref digests
+        "pack_lease",  # native core: (key, entry) from _pack_entry
+        "packed",      # native core: (joined, blob, offsets, lens)
+        "device_lease",  # device route: filled staging from _stage_device_blob
+    )
+
+    def __init__(self):
+        for name in self.__slots__:
+            setattr(self, name, None)
+
+    def release(self) -> None:
+        """Return unconsumed staging leases to the pool (idempotent)."""
+        if self.pack_lease is not None:
+            key, entry = self.pack_lease
+            self.pack_lease = self.packed = None
+            _staging.give(key, entry)
+        if self.device_lease is not None:
+            key, entry = self.device_lease[0], self.device_lease[1]
+            self.device_lease = None
+            _staging.give(key, entry)
+
+
 def _extract_ref_digests(node: bytes) -> List[bytes]:
     """The 32-byte child hash references of one RLP trie node (branch
     children, extension child, account-leaf storage root). Malformed nodes
@@ -270,6 +482,9 @@ class WitnessEngine:
         resident: Optional[bool] = None,
         resident_cap: Optional[int] = None,
         depth_hist: Optional[bool] = None,
+        tiered_evict: Optional[bool] = None,
+        pin_depth: Optional[int] = None,
+        pin_budget: Optional[int] = None,
     ):
         """device_batch_floor: minimum novel-batch size that goes to the
         device hasher under `--crypto_backend=tpu`. -1 (default) = adaptive:
@@ -309,7 +524,30 @@ class WitnessEngine:
         depth_hist: record the `cache_hit_rate vs trie_depth` histogram
         (witness_engine.depth_{hits,misses}{depth=}) on every batch.
         None = PHANT_DEPTH_HIST (default off: first sight of a node
-        costs one extra host hash for the depth memo)."""
+        costs one extra host hash for the depth memo).
+
+        tiered_evict: depth-TIERED generation eviction (PR 9, default
+        ON; PHANT_TIERED_EVICT=0 disables). A generation flush pins the
+        shallow tier (depth <= pin_depth, the near-100%-hit rows per
+        the PR 8 histogram) by re-committing it into the fresh
+        generation with its remembered digests — zero re-hashing —
+        while deeper tiers evict generationally; the device-resident
+        table re-commits the same set so host and device stay in
+        lockstep. Classification is the zero-hot-path-cost _PinTracker
+        (roots are depth 0 by definition; novel nodes classify when
+        their digests surface at commit). On the ext core, tiering
+        routes novel hashing through the Python-visible batch keccak
+        instead of the in-C finish_native fast path so digests surface
+        — same C hashing, one extra round trip, novel counts go to ~0
+        in the steady state.
+
+        pin_depth: deepest tier pinned across flushes (default
+        PHANT_PIN_DEPTH=2 — the histogram's near-100%-hit depths).
+
+        pin_budget: pinned-set row bound (default PHANT_PIN_BUDGET or
+        max_nodes // 8); at flush time pins allocate shallow-first from
+        the live classification until the budget (or the room the
+        incoming batch needs) is exhausted."""
         # native C++ core (native/engine.cc): same interning + verdict
         # semantics, ~5-10x the steady-state throughput (no Python dict
         # re-hash of node bytes, no numpy sort in the join). Preferred
@@ -385,6 +623,36 @@ class WitnessEngine:
         if depth_hist is None:
             depth_hist = os.environ.get("PHANT_DEPTH_HIST", "0") == "1"
         self._depth = _DepthStats(max_nodes) if depth_hist else None
+        # depth-tiered eviction (PR 9): the shallow-node pin tracker plus
+        # an ADVISORY committed-bytes set for the prefetch pre-scan. Both
+        # are engine-lock-guarded at every write; the pre-scan reads the
+        # set without the lock (GIL-atomic membership, re-checked by the
+        # authoritative pack-time scan).
+        if tiered_evict is None:
+            tiered_evict = os.environ.get("PHANT_TIERED_EVICT", "1") not in (
+                "0",
+                "",
+            )
+        if pin_depth is None:
+            pin_depth = int(os.environ.get("PHANT_PIN_DEPTH", "2"))
+        if pin_budget is None:
+            pin_budget = int(
+                os.environ.get("PHANT_PIN_BUDGET", str(max(1, max_nodes // 8)))
+            )
+        self._pin = _PinTracker(pin_depth, pin_budget) if tiered_evict else None
+        # the prefetch pre-scan's lock-free membership probe. The C cores
+        # keep their committed bytes in native memory, so this is the only
+        # host-side bytes-keyed view of the tables — which is exactly why
+        # it must stay LAZY: it duplicates up to max_nodes of node bytes,
+        # and an engine that never serves a prefetch consumer (depth-1
+        # scheduler, --sched-prefetch 0, offline verify_batch) must not
+        # pay that. _advisory_add is a no-op until the first
+        # prefetch_batch call activates it (python core: seeded exactly
+        # from _row_of_bytes; C cores: warms with subsequent commits — a
+        # cold start under-reports hits, a perf miss the authoritative
+        # pack-time scan absorbs).
+        self._seen_advisory: set = set()
+        self._advisory_active = False
         self.stats = {"hashed": 0, "hits": 0, "evictions": 0}
 
     # -- hashing backends ---------------------------------------------------
@@ -549,6 +817,9 @@ class WitnessEngine:
             self._n_refids = 0
             self._evict_pending = False
             self._evict_pending_py = False
+            self._seen_advisory.clear()
+            if self._pin is not None:
+                self._pin.flush()
             self.stats["resets"] = self.stats.get("resets", 0) + 1
             res, self._resident = self._resident, None
         if res is not None:
@@ -556,42 +827,35 @@ class WitnessEngine:
         if self._depth is not None:
             self._depth.flush()
 
-    def _flush_attached_locked(self) -> None:
+    def _flush_attached_locked(self, pinned: Sequence[tuple] = ()) -> None:
         """Flush the device-resident table and the depth memo together
         with a host GENERATION flush (caller holds the engine lock with
         an empty pipeline): host and device tables evict in lockstep, so
-        they never disagree about what exists. The python-TWIN-only
-        flush (`_evict_pending_py`) deliberately does not come here —
-        the core (and its resident mirror) stay warm there."""
+        they never disagree about what exists. With a tiered flush the
+        resident table re-commits the same `pinned` set the host just
+        retained — row ids restart together, the open-addressed index is
+        rebuilt over exactly the pinned fingerprints, and the two tables
+        keep agreeing about what exists. The python-TWIN-only flush
+        (`_evict_pending_py`) deliberately does not come here — the core
+        (and its resident mirror) stay warm there."""
         if self._resident is not None:
-            self._resident.flush()
+            if pinned:
+                self._resident.flush_retaining([nb for nb, _dg, _d in pinned])
+            else:
+                self._resident.flush()
         if self._depth is not None:
             self._depth.flush()
 
     @staticmethod
-    def _device_dispatch(nodes: List[bytes], device=None):
-        """Enqueue one fused device dispatch of the concatenated novel
-        bytes WITHOUT any host sync: returns a keccak_jax.DeviceDigests
-        handle whose `resolve()` pays the readback. The transfer is the
-        novel bytes + 2B/node — the memoized design makes this the ONLY
-        recurring h2d traffic of witness verification. Both the node axis
-        AND the blob byte axis are padded to power-of-two buckets so
-        repeat calls hit a small set of compiled shapes (a ragged blob
-        length would recompile per call) — and the padded staging arrays
-        themselves are leased from `_staging` keyed by that same bucket,
-        so steady-state batches stop reallocating (and page-zeroing) the
-        blob every call. The lease returns to the pool on resolve, when
-        the device can no longer be reading the buffers.
-
-        `device` pins the dispatch: inputs are device_put-committed to
-        that one device (jax places the compute with them) and the
-        mesh-sharded route is skipped — a pinned engine is one lane of
-        the serving pool's mesh, never a whole-mesh dispatcher."""
-        import jax.numpy as jnp
-
+    def _stage_device_blob(nodes: List[bytes]) -> tuple:
+        """Lease + fill the pow2-bucketed device staging for one novel
+        set: (key, entry, n_nodes) — the host-side half of a device
+        dispatch, split out so the PREFETCH stage can run it off the
+        serving critical path (PrefetchPlan.device_lease). Raises
+        ValueError for a node past the kernel's absorb capacity, same
+        contract as the dispatch itself."""
         from phant_tpu.crypto.keccak import RATE
-        from phant_tpu.ops.keccak_jax import DeviceDigests
-        from phant_tpu.ops.witness_jax import _pow2ceil, witness_digests
+        from phant_tpu.ops.witness_jax import _pow2ceil
 
         limit = WITNESS_MAX_CHUNKS * RATE
         for n in nodes:
@@ -626,6 +890,42 @@ class WitnessEngine:
         entry["lens_dirty"] = len(nodes)
         offsets[0] = 0
         np.cumsum(lens[:-1], out=offsets[1:])
+        return (key, entry, len(nodes))
+
+    @staticmethod
+    def _device_dispatch(nodes: List[bytes], device=None, staged=None):
+        """Enqueue one fused device dispatch of the concatenated novel
+        bytes WITHOUT any host sync: returns a keccak_jax.DeviceDigests
+        handle whose `resolve()` pays the readback. The transfer is the
+        novel bytes + 2B/node — the memoized design makes this the ONLY
+        recurring h2d traffic of witness verification. Both the node axis
+        AND the blob byte axis are padded to power-of-two buckets so
+        repeat calls hit a small set of compiled shapes (a ragged blob
+        length would recompile per call) — and the padded staging arrays
+        themselves are leased from `_staging` keyed by that same bucket,
+        so steady-state batches stop reallocating (and page-zeroing) the
+        blob every call. The lease returns to the pool on resolve, when
+        the device can no longer be reading the buffers.
+
+        `device` pins the dispatch: inputs are device_put-committed to
+        that one device (jax places the compute with them) and the
+        mesh-sharded route is skipped — a pinned engine is one lane of
+        the serving pool's mesh, never a whole-mesh dispatcher.
+
+        `staged` hands in a pre-filled lease from `_stage_device_blob`
+        (the prefetch stage's output for exactly these nodes); ownership
+        transfers here — the lease returns to the pool on resolve, or
+        right away if the enqueue fails."""
+        import jax.numpy as jnp
+
+        from phant_tpu.ops.keccak_jax import DeviceDigests
+        from phant_tpu.ops.witness_jax import witness_digests
+
+        if staged is None:
+            staged = WitnessEngine._stage_device_blob(nodes)
+        key, entry, _n = staged
+        blob, lens, offsets = entry["blob"], entry["lens"], entry["offsets"]
+        B = len(lens)
         import os
 
         import jax
@@ -833,7 +1133,11 @@ class WitnessEngine:
         return rows, novel, len(miss_idx)
 
     def _commit_novel_locked(
-        self, rows: np.ndarray, novel: List[bytes], digests: List[bytes]
+        self,
+        rows: np.ndarray,
+        novel: List[bytes],
+        digests: List[bytes],
+        ref_hint: Optional[Dict[bytes, list]] = None,
     ) -> None:
         """Insert `novel` (with caller-computed digests), intern every
         digest + child reference, and patch the negative entries of `rows`
@@ -863,7 +1167,19 @@ class WitnessEngine:
             fresh_digests = [digests[k] for k in fresh_idx]
 
         if fresh:
-            ref_digests, ref_node = self._refs_for_batch(fresh)
+            if ref_hint is not None and all(nb in ref_hint for nb in fresh):
+                # prefetch already RLP-decoded these nodes' child refs
+                # (content-derived: bytes -> refs can never go stale, it
+                # can only go unused when the hint misses a fresh node)
+                ref_digests = []
+                ref_node_l: List[int] = []
+                for i, nb in enumerate(fresh):
+                    for r in ref_hint[nb]:
+                        ref_digests.append(r)
+                        ref_node_l.append(i)
+                ref_node = np.asarray(ref_node_l, np.int64)
+            else:
+                ref_digests, ref_node = self._refs_for_batch(fresh)
             base_row = self._n_rows
             self._n_rows += len(fresh)
             self._grow(self._n_rows)
@@ -952,15 +1268,25 @@ class WitnessEngine:
                     # tally so the stats RPC doesn't double-count the
                     # re-interned scan
                     self.stats["hits"] = hits_before
-                    self._evict_all()
-                    self._flush_attached_locked()  # generation flush: sync
+                    if self._ext_core is None and self._core is None:
+                        # the python tables ARE this engine's verify
+                        # core: a real generation flush, tiered like
+                        # every other scan site (pins re-commit, room
+                        # reserved for this batch's novels)
+                        self._evict_now_locked(incoming_novel=len(novel))
+                    else:
+                        self._evict_all()
+                        self._flush_attached_locked()  # generation flush
                     # re-intern into the new generation (lock already held)
                     return self._intern_locked(nodes)
+            self._advisory_add(novel)
             digests = self._hash_batch(novel)
             self.stats["hashed"] += len(novel)
             self.stats["novel_bytes"] = self.stats.get("novel_bytes", 0) + sum(
                 map(len, novel)
             )
+            if self._pin is not None:
+                self._pin.note_novel(novel, digests)
             self._commit_novel_locked(rows, novel, digests)
         return rows
 
@@ -1007,17 +1333,19 @@ class WitnessEngine:
                     for stat_key, metric in (
                         ("hits", "witness_engine.cache_hits"),
                         ("hashed", "witness_engine.cache_misses"),
-                        ("evictions", "witness_engine.evictions"),
                         ("novel_bytes", "witness_engine.novel_bytes_hashed"),
                     )
                 ]
+                evict_tiers = self._evictions_by_tier(s0, s1)
                 snap = self._stats_snapshot_locked()
         for metric, d in deltas:
             if d:
-                # names come from the literal tuple above — all four are in
-                # METRIC_HELP; the loop only exists to batch the registry
-                # calls outside the engine lock
+                # names come from the literal tuple above — all three are
+                # in METRIC_HELP; the loop only exists to batch the
+                # registry calls outside the engine lock
                 metrics.count(metric, d)  # phantlint: disable=METRICNAME — names from the literal tuple above
+        for tier, d in evict_tiers:
+            metrics.count("witness_engine.evictions", d, tier=tier)
         metrics.gauge_set("witness_engine.interned_nodes", snap["interned_nodes"])
         metrics.gauge_set(
             "witness_engine.interned_digests", snap["interned_digests"]
@@ -1026,8 +1354,109 @@ class WitnessEngine:
 
     # -- pipelined two-phase API (pack / dispatch / resolve) -----------------
 
-    def begin_batch(
+    def _advisory_add(self, nodes) -> None:
+        """Commit-site hook for the prefetch advisory set: a no-op until
+        the first prefetch_batch activates it (no consumer, no copy)."""
+        if self._advisory_active:
+            self._seen_advisory.update(nodes)
+
+    def _advisory_activate(self) -> None:
+        """First prefetch_batch call: start maintaining the advisory set.
+        The python core's committed bytes are its _row_of_bytes keys —
+        seed exactly (key references, no byte copies). The C cores hold
+        bytes natively; they warm with commits from here on."""
+        with self._lock:
+            if not self._advisory_active:
+                if self._ext_core is None and self._core is None:
+                    self._seen_advisory.update(self._row_of_bytes)
+                self._advisory_active = True
+
+    def prefetch_batch(
         self, witnesses: Sequence[Tuple[bytes, Sequence[bytes]]]
+    ) -> PrefetchPlan:
+        """STAGE 0 of the 4-stage serving pipeline (PR 9): witness
+        decode + advisory novelty pre-scan for a batch that will be
+        `begin_batch`'d next — host batch assembly, the candidate-novel
+        scan against the advisory committed-bytes set, the candidates'
+        child-reference RLP decode (python core), and pre-filled staging
+        leases (native pack blob / device dispatch blob). A prefetch
+        worker runs this while the previous batch is in dispatch/resolve,
+        so the pack stage's critical-path work shrinks to the lock-held
+        re-check + commit.
+
+        Read-only against the tables: the advisory set is probed WITHOUT
+        the engine lock (GIL-atomic membership reads racing concurrent
+        commits benignly). The staleness contract is absolute — the
+        pack-time scan under the lock stays the authoritative commit, so
+        a stale plan (concurrent commit, generation flush, shed jobs) is
+        dropped at a perf cost of zero correctness risk. Pass the SAME
+        witnesses list to `begin_batch(witnesses, prefetch=plan)`; an
+        unused plan must be `release()`d."""
+        with metrics.phase("witness_engine.prefetch"):
+            return self._prefetch_plan(witnesses)
+
+    def _prefetch_plan(self, witnesses) -> PrefetchPlan:
+        # phantlint: disable=LOCK — double-checked activation: this
+        # GIL-atomic read only short-circuits the common case; a stale
+        # False costs one _advisory_activate call, which re-checks the
+        # flag UNDER the lock before doing anything
+        if not self._advisory_active:
+            self._advisory_activate()
+        plan = PrefetchPlan()
+        plan.witnesses = witnesses
+        n_blocks = len(witnesses)
+        all_nodes: List[bytes] = []
+        counts = np.empty(n_blocks, np.int64)
+        for b, (_root, nodes) in enumerate(witnesses):
+            counts[b] = len(nodes)
+            all_nodes.extend(nodes)
+        plan.all_nodes = all_nodes
+        plan.counts = counts
+        # phantlint: disable=LOCK — advisory pre-scan, deliberately
+        # lock-free: set membership under the GIL is atomic, a racing
+        # commit only makes the answer stale, and stale is re-checked by
+        # the authoritative pack-time scan (the staleness contract)
+        seen = self._seen_advisory
+        novel: List[bytes] = []
+        dedup = set()
+        for nb in all_nodes:
+            if nb not in seen and nb not in dedup:
+                dedup.add(nb)
+                novel.append(nb)
+        plan.novel = novel
+        with self._lock:
+            ext, core = self._ext_core, self._core
+        if ext is None and core is not None:
+            # the native core's scan/commit consume the packed C-ABI
+            # blob: lease + fill it here, off the serving critical path
+            plan.pack_lease = self._pack_entry(len(all_nodes))
+            plan.packed = self._pack_blob(all_nodes, plan.pack_lease[1])
+        if ext is None and core is None and novel:
+            # python core: the commit's child-ref extraction is host-side
+            # RLP parsing — decode the candidates here. Content-derived,
+            # so a hint can never go stale (only unused).
+            refs, ref_node = self._refs_for_batch(novel)
+            by_node: Dict[bytes, list] = {nb: [] for nb in novel}
+            for r, i in zip(refs, ref_node.tolist()):
+                by_node[novel[i]].append(r)
+            plan.refs = by_node
+        if (
+            novel
+            and self._hasher is None
+            and not self._resident_wanted()
+            and not self._native_route_certain()
+            and self._device_route_wanted(novel)
+        ):
+            try:
+                plan.device_lease = self._stage_device_blob(novel)
+            except ValueError:
+                pass  # oversized node: dispatch will route native anyway
+        return plan
+
+    def begin_batch(
+        self,
+        witnesses: Sequence[Tuple[bytes, Sequence[bytes]]],
+        prefetch: Optional[PrefetchPlan] = None,
     ) -> BatchHandle:
         """Pack + dispatch one verify batch WITHOUT the device round-trip:
         the engine lock is held only for the intern-table scan (pack), the
@@ -1042,11 +1471,34 @@ class WitnessEngine:
         schedulers sharing one engine — stay sound; the serving resolve
         worker happens to be FIFO for per-requester ordering);
         `verify_batch` remains the one-call depth-1 equivalent and may
-        interleave freely with in-flight handles."""
+        interleave freely with in-flight handles.
+
+        `prefetch` consumes a plan from `prefetch_batch` run over the
+        SAME witnesses list: pack reuses the plan's assembly + staging
+        leases, and when the authoritative scan confirms the plan's
+        candidate-novel set the device dispatch reuses its pre-filled
+        blob too. A mismatched/stale plan is released and ignored —
+        the plan is advisory, this scan is the commit."""
         if self._depth is not None:
             self._depth.record(witnesses)
+        plan = prefetch
+        if plan is not None and plan.witnesses is not witnesses:
+            # not the batch this plan was computed for: drop it whole
+            plan.release()
+            plan = None
         with metrics.phase("witness_engine.pack"):
-            h = self._pack_handle(witnesses)
+            h = self._pack_handle(witnesses, plan)
+        used = plan is not None and h.novel == plan.novel
+        if plan is not None:
+            if used:
+                metrics.count("witness_engine.prefetch_plan_hits")
+            else:
+                metrics.count("witness_engine.prefetch_plan_stale")
+            if plan.refs is not None and h.kind == "python":
+                # content-derived: valid even under a stale candidate
+                # set (the commit only uses it when it covers every
+                # fresh node)
+                h.ref_hint = plan.refs
         with metrics.phase("witness_engine.dispatch"):
             if self._resident_wanted():
                 # device-resident route: update (novel bytes only) +
@@ -1057,8 +1509,15 @@ class WitnessEngine:
                 not self._native_route_certain()
                 and self._device_route_wanted(h.novel)
             ):
+                staged = None
+                if used and plan.device_lease is not None:
+                    # ownership moves to the dispatch (lease returns to
+                    # the pool at resolve, or on enqueue failure)
+                    staged, plan.device_lease = plan.device_lease, None
                 try:
-                    h.device = self._device_dispatch(h.novel, self._pinned_device())
+                    h.device = self._device_dispatch(
+                        h.novel, self._pinned_device(), staged=staged
+                    )
                 except Exception:
                     import logging
 
@@ -1068,9 +1527,13 @@ class WitnessEngine:
                         len(h.novel),
                         exc_info=True,
                     )
+        if plan is not None:
+            plan.release()  # whatever was not consumed goes back pooled
         return h
 
-    def _pack_handle(self, witnesses) -> BatchHandle:
+    def _pack_handle(
+        self, witnesses, plan: Optional[PrefetchPlan] = None
+    ) -> BatchHandle:
         h = BatchHandle()
         h.n_blocks = len(witnesses)
         with self._lock:
@@ -1082,13 +1545,27 @@ class WitnessEngine:
         if ext is None:
             # host-side batch assembly + blob packing stays OUTSIDE the
             # lock: it touches no engine table, and it is exactly the work
-            # the pipeline overlaps with the previous batch's resolve
-            counts = np.empty(h.n_blocks, np.int64)
-            for b, (_root, nodes) in enumerate(witnesses):
-                counts[b] = len(nodes)
-                all_nodes.extend(nodes)
-            h.counts = counts
-            if core is not None:
+            # the pipeline overlaps with the previous batch's resolve —
+            # or, with a prefetch plan, the work ALREADY DONE off the
+            # critical path (assembly is content-derived from the same
+            # witnesses list, so it is valid even when the plan's novelty
+            # pre-scan went stale)
+            if plan is not None and plan.all_nodes is not None:
+                all_nodes = plan.all_nodes
+                h.counts = plan.counts
+                if core is not None and plan.packed is not None:
+                    # staging ownership moves plan -> handle (the lease
+                    # returns to the pool at resolve, like every pack)
+                    h.pack_entry = plan.pack_lease
+                    h.joined, h.blob, h.offsets, h.lens = plan.packed
+                    plan.pack_lease = plan.packed = None
+            else:
+                counts = np.empty(h.n_blocks, np.int64)
+                for b, (_root, nodes) in enumerate(witnesses):
+                    counts[b] = len(nodes)
+                    all_nodes.extend(nodes)
+                h.counts = counts
+            if core is not None and h.pack_entry is None:
                 h.pack_entry = self._pack_entry(len(all_nodes))
                 h.joined, h.blob, h.offsets, h.lens = self._pack_blob(
                     all_nodes, h.pack_entry[1]
@@ -1101,7 +1578,7 @@ class WitnessEngine:
             self._await_evict_window_locked()
             if not self._inflight:
                 self._run_deferred_evictions_locked()
-            evictions_before = self.stats["evictions"]
+            s0 = dict(self.stats)
             if ext is not None:
                 h.kind = "ext"
                 h.ext_batch, novel, miss, total = ext.scan_begin(witnesses)
@@ -1128,17 +1605,24 @@ class WitnessEngine:
                 h.witnesses = witnesses
             self.stats["hits"] += h.total - h.miss
             h.n_novel = len(h.novel)
+            if self._pin is not None:
+                # roots are depth-0 digests by definition (tier tracker)
+                self._pin.note_roots([root for root, _nodes in witnesses])
             if h.novel:
+                # optimistic advisory update at SCAN time: the commit is
+                # coming; an abandoned handle over-approximates, which
+                # only costs the prefetch pre-scan accuracy
+                self._advisory_add(h.novel)
                 self.stats["hashed"] += len(h.novel)
                 self.stats["novel_bytes"] = self.stats.get(
                     "novel_bytes", 0
                 ) + sum(map(len, h.novel))
             self._inflight += 1
-            evictions_delta = self.stats["evictions"] - evictions_before
+            evict_tiers = self._evictions_by_tier(s0, self.stats)
         # registry publishes after release (the metrics lock never nests
         # inside ours — same discipline as verify_batch)
-        if evictions_delta:
-            metrics.count("witness_engine.evictions", evictions_delta)
+        for tier, d in evict_tiers:
+            metrics.count("witness_engine.evictions", d, tier=tier)
         return h
 
     def resolve_batch(self, handle: BatchHandle) -> np.ndarray:
@@ -1235,6 +1719,13 @@ class WitnessEngine:
             h.resident is None
             and h.kind == "ext"
             and n_novel > 0
+            # tiered eviction needs the novel digests at the Python level
+            # (the pin tracker classifies on them); route through the
+            # batch keccak + finish instead of the in-C finish_native —
+            # same C hashing, one extra round trip, and novel counts go
+            # to ~0 in the steady state anyway
+            # phantlint: disable=LOCK — `_pin` is assigned once in __init__ and never rebound; the tracker's own state only mutates under the engine lock
+            and self._pin is None
             and self._native_route_certain()
         )
         verdict_dev = None
@@ -1265,7 +1756,7 @@ class WitnessEngine:
             self.abandon_batch(h)
             raise
         with self._lock:
-            evictions_before = self.stats["evictions"]
+            s0 = dict(self.stats)
             try:
                 if h.kind == "ext":
                     with metrics.phase("witness_engine.linkage_join"):
@@ -1292,12 +1783,18 @@ class WitnessEngine:
                             )
                 else:
                     if n_novel:
-                        self._commit_novel_locked(h.rows, h.novel, digests)
+                        self._commit_novel_locked(
+                            h.rows, h.novel, digests, ref_hint=h.ref_hint
+                        )
                     if verdict_dev is None:
                         with metrics.phase("witness_engine.linkage_join"):
                             verdict = self._linkage_join(
                                 h.witnesses, h.rows, h.counts, h.n_blocks
                             )
+                if self._pin is not None and digests and n_novel:
+                    # novel digests surfaced (device / native / resident
+                    # readback): classify them for the tiered flush
+                    self._pin.note_novel(h.novel, digests)
                 if verdict_dev is not None:
                     # the device join IS the verdict on the resident
                     # route (the host join is skipped — the ext core's
@@ -1321,12 +1818,12 @@ class WitnessEngine:
                 # pipeline bookkeeping (deferred evictions would never run)
                 h.resolved = True
                 self._release_inflight_locked()
-            evictions_delta = self.stats["evictions"] - evictions_before
+            evict_tiers = self._evictions_by_tier(s0, self.stats)
             snap = self._stats_snapshot_locked()
-        if evictions_delta:
+        for tier, d in evict_tiers:
             # a resolve-drain flush counts like any other (pack publishes
             # its delta the same way — the metric must not undercount)
-            metrics.count("witness_engine.evictions", evictions_delta)
+            metrics.count("witness_engine.evictions", d, tier=tier)
         if n_novel:
             metrics.count("witness_engine.cache_misses", n_novel)
             metrics.count(
@@ -1344,6 +1841,27 @@ class WitnessEngine:
         h.witnesses = None
         h.ext_batch = None
         return verdict, snap
+
+    @staticmethod
+    def _evictions_by_tier(s0: dict, s1: dict) -> List[Tuple[str, int]]:
+        """(tier, delta) pairs for the `witness_engine.evictions{tier=}`
+        metric from a stats delta captured under the engine lock:
+        tier="deep" pinned the shallow set and evicted only the deeper
+        tiers, tier="full" dropped everything (tiering off, or no pins),
+        tier="twin" flushed only the python twin tables of a C-core
+        engine (the public intern() overflow path). Publishing happens
+        at the caller, outside the lock."""
+        out: List[Tuple[str, int]] = []
+        tiered = 0
+        for tier in ("deep", "full"):
+            d = s1.get("evictions_" + tier, 0) - s0.get("evictions_" + tier, 0)
+            tiered += d
+            if d:
+                out.append((tier, d))
+        twin = s1.get("evictions", 0) - s0.get("evictions", 0) - tiered
+        if twin:
+            out.append(("twin", twin))
+        return out
 
     def _release_inflight_locked(self) -> None:
         """Drop one in-flight handle (resolve or abandon). When the
@@ -1418,22 +1936,85 @@ class WitnessEngine:
         if self._inflight:
             self._evict_pending = True
             return False
-        self._evict_now_locked()
+        self._evict_now_locked(incoming_novel=n_novel)
         return True
 
-    def _evict_now_locked(self) -> None:
+    def _evict_now_locked(self, incoming_novel: int = 0) -> None:
         """Generation flush on whichever core is live. Caller holds the
         lock AND has checked `self._inflight == 0` — flushing under an
-        outstanding pipelined batch would strand its scanned row ids."""
+        outstanding pipelined batch would strand its scanned row ids.
+
+        With tiered eviction (`_pin`), the flush is DEPTH-TIERED: the
+        shallow pinned set (depth <= pin_depth, shallow-first within the
+        budget) re-commits into the fresh generation with its remembered
+        digests — no re-hashing — while everything deeper evicts
+        generationally. `incoming_novel` reserves room for the batch
+        that triggered the flush, so pins can never crowd out live
+        traffic (and a single over-cap batch degrades to the flat
+        flush). The tier label rides the evictions metric: tier="deep"
+        evicted only the deep tiers, tier="full" dropped everything."""
+        pinned: List[tuple] = []
+        if self._pin is not None:
+            room = self._max_nodes - incoming_novel
+            if room > 0:
+                pinned = self._pin.pinned_snapshot()[:room]
+        self.stats["evictions"] += 1
+        tier = "deep" if pinned else "full"
+        self.stats["evictions_" + tier] = self.stats.get(
+            "evictions_" + tier, 0
+        ) + 1
         if self._ext_core is not None:
-            self.stats["evictions"] += 1
             self._ext_core.flush()
         elif self._core is not None:
-            self.stats["evictions"] += 1
             self._core.flush()
         else:
-            self._evict_all()
-        self._flush_attached_locked()
+            self._row_of_bytes.clear()
+            self._refid_of_digest.clear()
+            self._n_rows = 0
+            self._n_refids = 0
+        self._seen_advisory.clear()
+        if pinned:
+            self._recommit_pinned_locked(pinned)
+        self.stats["pinned_retained"] = len(pinned)
+        self._flush_attached_locked(pinned)
+
+    def _recommit_pinned_locked(self, pinned: Sequence[tuple]) -> None:
+        """Insert the pinned shallow set into the just-flushed generation
+        with its REMEMBERED digests — the scan/commit protocols every
+        core already exposes, fed known digests instead of fresh keccak.
+        The ext core runs one throwaway scan_begin/finish_batch pair
+        (the verdict of the dummy block is discarded); row/refid spaces
+        restart at zero with the pins as the first rows on every core,
+        so cross-core parity holds."""
+        nodes = [nb for nb, _dg, _d in pinned]
+        dmap = {nb: dg for nb, dg, _d in pinned}
+        if self._ext_core is not None:
+            batch, novel, _miss, _total = self._ext_core.scan_begin(
+                [(b"\x00" * 32, nodes)]
+            )
+            self._ext_core.finish_batch(
+                batch, b"".join(dmap[nb] for nb in novel) if novel else None
+            )
+        elif self._core is not None:
+            joined, blob, offsets, lens = self._pack_blob(nodes)
+            rows, novel_idx, _miss = self._core.scan(blob, offsets, lens)
+            if len(novel_idx):
+                self._core.commit(
+                    blob,
+                    offsets,
+                    lens,
+                    rows,
+                    novel_idx,
+                    b"".join(dmap[nodes[i]] for i in novel_idx.tolist()),
+                )
+            del joined  # kept alive across the ctypes calls above
+        else:
+            rows, novel, _miss = self._scan_rows_locked(nodes)
+            if novel:
+                self._commit_novel_locked(
+                    rows, novel, [dmap[nb] for nb in novel]
+                )
+        self._advisory_add(nodes)
 
     def _verify_batch_locked(
         self, witnesses: Sequence[Tuple[bytes, Sequence[bytes]]]
@@ -1461,6 +2042,8 @@ class WitnessEngine:
         otherwise the novel list comes back here so the backend route
         applies identically to every core."""
         st = self._ext_core
+        if self._pin is not None:
+            self._pin.note_roots([root for root, _nodes in witnesses])
         with metrics.phase("witness_engine.intern"):
             novel, miss, total = st.scan(witnesses)
         n_novel = len(novel)
@@ -1469,13 +2052,14 @@ class WitnessEngine:
                 with metrics.phase("witness_engine.intern"):
                     novel, miss, total = st.scan(witnesses)
                 n_novel = len(novel)
+            self._advisory_add(novel)
             route_device = not self._native_route_certain() and (
                 self._device_route_wanted(novel)
             )
             self.stats["novel_bytes"] = self.stats.get("novel_bytes", 0) + sum(
                 map(len, novel)
             )
-            if not route_device:
+            if not route_device and self._pin is None:
                 # the routed hasher for THIS batch is the host: hash inside
                 # the extension, zero Python round trip.  (With the Pallas
                 # kernel the offload gate is open in principle, so the
@@ -1491,8 +2075,13 @@ class WitnessEngine:
                 with metrics.phase("witness_engine.hash"):
                     verdict = st.finish_native()
             else:
-                digests = self._hash_batch(novel, route_device=True)
+                # device-routed, or tiered eviction needs the digests at
+                # the Python level: the batch keccak route (device or
+                # native per the cost model) surfaces them
+                digests = self._hash_batch(novel, route_device=route_device)
                 self.stats["hashed"] += n_novel
+                if self._pin is not None:
+                    self._pin.note_novel(novel, digests)
                 with metrics.phase("witness_engine.linkage_join"):
                     verdict = st.finish(b"".join(digests))
         else:
@@ -1553,6 +2142,8 @@ class WitnessEngine:
         bench's hasher override) applies identically to both cores."""
         core = self._core
         n = len(all_nodes)
+        if self._pin is not None:
+            self._pin.note_roots([root for root, _nodes in witnesses])
         # `joined` kept alive across the ctypes calls
         joined, blob, offsets, lens = self._pack_blob(all_nodes)
         with metrics.phase("witness_engine.intern"):
@@ -1562,11 +2153,14 @@ class WitnessEngine:
                 with metrics.phase("witness_engine.intern"):
                     rows, novel_idx, miss = core.scan(blob, offsets, lens)
             novel = [all_nodes[i] for i in novel_idx.tolist()]
+            self._advisory_add(novel)
             digests = self._hash_batch(novel)
             self.stats["hashed"] += len(novel)
             self.stats["novel_bytes"] = self.stats.get("novel_bytes", 0) + sum(
                 map(len, novel)
             )
+            if self._pin is not None:
+                self._pin.note_novel(novel, digests)
             core.commit(blob, offsets, lens, rows, novel_idx, b"".join(digests))
         self.stats["hits"] += n - miss
         block_offs = np.zeros(n_blocks + 1, np.uint64)
@@ -1578,6 +2172,8 @@ class WitnessEngine:
     def _verify_interned(self, witnesses, all_nodes, counts, n_blocks):
         # the intern phase includes the nested witness_engine.hash phase of
         # any novel nodes; linkage-join covers the integer-join verdict
+        if self._pin is not None:
+            self._pin.note_roots([root for root, _nodes in witnesses])
         with metrics.phase("witness_engine.intern"):
             rows = self._intern_locked(all_nodes)
         with metrics.phase("witness_engine.linkage_join"):
@@ -1663,6 +2259,16 @@ class WitnessEngine:
             st["device_index"] = self._device_index
             if self._pinned is not None:
                 st["device"] = str(self._pinned)
+        if self._pin is not None:
+            # depth-tiered eviction (PR 9): the live pin classification —
+            # how many shallow rows the next generation flush would
+            # retain, per depth (the histogram-derived tier model)
+            st["tiered_evict"] = True
+            st["pin_depth"] = self._pin.pin_depth
+            st["pinned_rows"] = len(self._pin._pinned)
+            st["pinned_per_depth"] = {
+                str(d): c for d, c in sorted(self._pin.per_depth().items())
+            }
         if self._resident is not None:
             # device-resident intern table: rows/generation plus the
             # upload accounting (novel bytes shipped vs pruned) — the

@@ -365,6 +365,58 @@ class ResidentTable:
         self.generation += 1
         self.stats["flushes"] += 1
 
+    def flush_retaining(self, nodes: Sequence[bytes]) -> None:
+        """Depth-TIERED generation flush (PR 9): drop every resident row,
+        then re-commit `nodes` — the owning engine's pinned shallow set,
+        in ITS snapshot order — into the fresh generation. Rows restart
+        at 0..len(nodes)-1 exactly like the host core's pinned re-commit,
+        and the open-addressed index is rebuilt over exactly the pinned
+        fingerprints, so host and device tables keep agreeing about what
+        exists across a tiered flush. The device re-hashes the pinned
+        bytes once per flush (the update program already fuses hash +
+        ref-extract + scatter + index insert) — flush-time cost, never
+        the per-batch hot path. Nodes the kernel cannot absorb, or past
+        max_cap, are silently dropped from the device set: the HOST
+        keeps them pinned and the prune re-uploads on next use — a perf
+        miss, never an inconsistency."""
+        from phant_tpu.crypto.keccak import RATE
+
+        limit = WITNESS_MAX_CHUNKS * RATE
+        with self._lock:
+            self._flush_locked()
+            keep = [n for n in nodes if len(n) < limit][: self._max_cap]
+            if not keep:
+                return
+            self._grow_locked(len(keep))
+            sob = self._slot_of_bytes
+            for j, nb in enumerate(keep):
+                sob[nb] = j
+            self._n_rows = len(keep)
+            raw = b"".join(keep)
+            blob_len = _pow2ceil(len(raw) + WITNESS_MAX_CHUNKS * RATE)
+            np_b = _pow2ceil(len(keep))
+            blob = np.zeros(blob_len, np.uint8)
+            blob[: len(raw)] = np.frombuffer(raw, np.uint8)
+            lens = np.zeros(np_b, np.int32)
+            lens[: len(keep)] = [len(nb) for nb in keep]
+            offsets = np.zeros(np_b, np.int32)
+            np.cumsum(lens[:-1], out=offsets[1:])
+            slots = np.full(np_b, -1, np.int32)
+            slots[: len(keep)] = np.arange(len(keep), dtype=np.int32)
+            out = self._update_fn(
+                *self._arrays,
+                self._put(blob),
+                self._put(offsets),
+                self._put(lens),
+                self._put(slots),
+                max_chunks=WITNESS_MAX_CHUNKS,
+            )
+            self._arrays = out[:5]
+            self._deferred_dropped.append(out[5])
+            self.stats["uploaded_nodes"] += len(keep)
+            self.stats["uploaded_bytes"] += len(raw)
+            self.stats["retained_rows"] = len(keep)
+
     def note_index_dropped(self, n: int) -> None:
         with self._lock:
             self.stats["index_dropped"] += n

@@ -161,6 +161,7 @@ class MeshExecutorPool:
         dispatch: str = "affinity",
         max_batch: int = 128,
         backlog_k: int = 0,
+        prefetch: bool = True,
         engine: Optional[object] = None,
         engine_factory: Optional[Callable[[int], object]] = None,
         on_done: Callable = None,
@@ -183,6 +184,12 @@ class MeshExecutorPool:
         self._dispatch_mode = dispatch
         self._max_batch = max_batch
         self._backlog_k = max(0, backlog_k)
+        # per-lane prefetch stage (PR 9): with a two-phase engine, the
+        # lane runs the witness decode + advisory novelty pre-scan
+        # (engine.prefetch_batch) before pack — on the lane thread, which
+        # is exactly when the lane's PREVIOUS batch is computing on its
+        # device (dispatch) or resolving, so the decode hides under them
+        self._prefetch = prefetch and self._depth > 1
         if engine_factory is None:
             if engine is not None:
                 engine_factory = lambda _i: engine
@@ -203,6 +210,7 @@ class MeshExecutorPool:
         self._served = [0] * self._n
         self._spills = 0
         self._megabatches = 0
+        self._prefetched = 0
         self._closed = False
         self._dead: Optional[BaseException] = None
         self._mega_mesh = None  # memoized (mesh, ok) probe for megabatch
@@ -438,11 +446,40 @@ class MeshExecutorPool:
                     item["jobs"] = jobs
                     cur, stage = item, "pack"
                     if two_phase:
+                        # the SAME witnesses list goes to prefetch and
+                        # begin: plan identity is the engine's match check
+                        wits = [(j.root, j.nodes) for j in jobs]
+                        plan = None
+                        pf = getattr(engine, "prefetch_batch", None)
+                        if self._prefetch and pf is not None:
+                            stage = "prefetch"
+                            self._on_stage(item["batch_id"], "prefetch", i)
+                            t0 = time.perf_counter()
+                            plan = pf(wits)
+                            item["prefetch_ms"] = round(
+                                (time.perf_counter() - t0) * 1e3, 3
+                            )
+                            metrics.count("sched.prefetch_batches")
+                            with self._lock:
+                                self._prefetched += 1
+                        stage = "pack"
                         self._on_stage(item["batch_id"], "pack", i)
                         t0 = time.perf_counter()
-                        handle = engine.begin_batch(
-                            [(j.root, j.nodes) for j in jobs]
-                        )
+                        try:
+                            if plan is not None:
+                                handle = engine.begin_batch(
+                                    wits, prefetch=plan
+                                )
+                            else:
+                                handle = engine.begin_batch(wits)
+                        except BaseException:
+                            # a lane death here reaches _die, which never
+                            # sees lane-local plans: return the staging
+                            # leases before propagating (idempotent; a
+                            # consumed/released plan is a no-op)
+                            if plan is not None:
+                                plan.release()
+                            raise
                         item["pack_ms"] = round(
                             (time.perf_counter() - t0) * 1e3, 3
                         )
@@ -545,9 +582,12 @@ class MeshExecutorPool:
         from phant_tpu.serving.scheduler import batch_record_from_handle
 
         jobs = item["jobs"]
-        return batch_record_from_handle(
+        record = batch_record_from_handle(
             handle, item["batch_id"], len(jobs), jobs[0].bucket
         )
+        if "prefetch_ms" in item:
+            record["prefetch_ms"] = item["prefetch_ms"]
+        return record
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -638,6 +678,7 @@ class MeshExecutorPool:
         out = {
             "devices": n,
             "dispatch": self._dispatch_mode,
+            "prefetch": self._prefetch,
             "all_alive": dead is None and all(alive_list),
             "per_device": per_device,
         }
@@ -654,6 +695,7 @@ class MeshExecutorPool:
                 "served": list(self._served),
                 "spills": self._spills,
                 "megabatches": self._megabatches,
+                "prefetched_batches": self._prefetched,
             }
 
     def engines(self) -> list:

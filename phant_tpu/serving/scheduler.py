@@ -198,6 +198,18 @@ def _default_pipeline_depth() -> int:
     return int(os.environ.get("PHANT_SCHED_PIPELINE_DEPTH", "2"))
 
 
+def _default_prefetch() -> bool:
+    """PHANT_SCHED_PREFETCH, default on: with pipeline_depth >= 2, a
+    dedicated prefetch worker runs batch N+1's witness decode + advisory
+    intern-table novelty pre-scan (ops/witness_engine.py prefetch_batch)
+    while batch N is in dispatch/resolve — the 4th pipeline stage
+    (prefetch -> pack -> dispatch -> resolve). 0 / `--sched-prefetch 0`
+    pins the PR-5 3-stage behavior. Prefetch is advisory end to end: the
+    pack-time scan under the engine lock stays the authoritative commit,
+    so a stale plan costs the perf win and nothing else."""
+    return os.environ.get("PHANT_SCHED_PREFETCH", "1") not in ("0", "")
+
+
 def _default_tenant_quota() -> int:
     """PHANT_SCHED_TENANT_QUOTA: per-tenant queued-witness cap; 0 (the
     default) means only the global queue_depth bounds a lane."""
@@ -274,6 +286,11 @@ class SchedulerConfig:
     # the executor packs/dispatches batch N+1 while the resolve worker
     # reads back + joins batch N); 1 = today's serialized execution
     pipeline_depth: int = field(default_factory=_default_pipeline_depth)
+    # 4th pipeline stage (PR 9): prefetch worker decodes + pre-scans batch
+    # N+1 while batch N is in dispatch/resolve. On whenever
+    # pipeline_depth >= 2; `--sched-prefetch 0` / PHANT_SCHED_PREFETCH=0
+    # opts out (the 3-stage PR-5 pipeline)
+    prefetch: bool = field(default_factory=_default_prefetch)
     # --- multi-tenant QoS (serving/qos.py) ---------------------------------
     # per-tenant queued-witness cap (0 = global queue_depth only)
     tenant_quota: int = field(default_factory=_default_tenant_quota)
@@ -302,6 +319,10 @@ class SchedulerConfig:
 
 _WITNESS = "witness"
 _SERIAL = "serial"
+
+#: _next_batch(block=False) found nothing queued (distinct from None =
+#: closed/dead): the prefetching executor re-evaluates its pending work
+_NO_BATCH = object()
 
 #: batch-size histogram buckets (requests per engine dispatch)
 _BATCH_BUCKETS: Tuple[float, ...] = (1, 2, 4, 8, 16, 32, 64, 128, 256)
@@ -489,6 +510,7 @@ class VerificationScheduler:
                 dispatch=self.config.mesh_dispatch,
                 max_batch=self._max_batch,
                 backlog_k=self.config.megabatch_backlog_k,
+                prefetch=self.config.prefetch,
                 engine=engine,
                 engine_factory=self.config.mesh_engine_factory,
                 on_done=self._mesh_done,
@@ -503,7 +525,12 @@ class VerificationScheduler:
         # fail-fast) against a live server / the real CLI
         import os
 
-        self._chaos_crash = os.environ.get("PHANT_SCHED_CHAOS_CRASH") == "1"
+        chaos = os.environ.get("PHANT_SCHED_CHAOS_CRASH")
+        self._chaos_crash = chaos == "1"
+        # PHANT_SCHED_CHAOS_CRASH=prefetch: the first plan the PREFETCH
+        # worker computes raises instead — the fire drill for the
+        # 4th-stage crash path (stage-named record, -32052 fail-fast)
+        self._chaos_prefetch = chaos == "prefetch"
         self._lock = threading.Lock()
         self._cond = threading.Condition(self._lock)
         # admission state (guarded by _lock): the serial mutation lane is
@@ -526,6 +553,20 @@ class VerificationScheduler:
         self._resolve_q: List[dict] = []
         self._resolving = False
         self._exec_stage = "pack"
+        # 4-stage pipeline state (guarded by _lock): batches the executor
+        # assembled and handed to the prefetch worker. `_prefetch_q` is
+        # the worker's input; `_prefetch_pending` is the executor's FIFO
+        # of the same items (popped when the plan is consumed) — _die
+        # drains BOTH so no future is stranded mid-prefetch. The
+        # lookahead bounds how many assembled batches wait on plans.
+        self._prefetch_on = (
+            self.config.prefetch
+            and self._pipe_depth >= 2
+            and self._pool is None  # mesh lanes prefetch per lane
+        )
+        self._prefetch_q: List[dict] = []
+        self._prefetch_pending: List[dict] = []
+        self._prefetch_lookahead = 2
         self.stats = {
             "requests": 0,
             "batches": 0,
@@ -534,6 +575,9 @@ class VerificationScheduler:
             "batched_requests": 0,
             "max_batch_seen": 0,
             "pipelined_batches": 0,
+            # 4-stage pipeline: batches whose decode + novelty pre-scan ran
+            # on the prefetch worker (stage 0) before pack consumed the plan
+            "prefetched_batches": 0,
             # mesh dispatch: batches routed into the per-device pool, and
             # full single-bucket batches sent as whole-mesh fused calls
             "mesh_batches": 0,
@@ -558,6 +602,12 @@ class VerificationScheduler:
                 target=self._resolve_run, name="phant-sched-resolve", daemon=True
             )
             self._resolve_thread.start()
+        self._prefetch_thread: Optional[threading.Thread] = None
+        if self._prefetch_on:
+            self._prefetch_thread = threading.Thread(
+                target=self._prefetch_run, name="phant-sched-prefetch", daemon=True
+            )
+            self._prefetch_thread.start()
         self._watchdog = Watchdog(self.inflight_state).start()
 
     # -- context manager (offline verify_many use) ---------------------------
@@ -875,7 +925,11 @@ class VerificationScheduler:
         the offline API for bench.py, the spec runner, and tests. Blocks on
         queue space instead of rejecting (offline callers want completion,
         not load shedding) and applies no deadline."""
-        if threading.current_thread() in (self._thread, self._resolve_thread):
+        if threading.current_thread() in (
+            self._thread,
+            self._resolve_thread,
+            self._prefetch_thread,
+        ):
             raise RuntimeError(
                 "verify_many called from a scheduler thread (deadlock)"
             )
@@ -895,7 +949,11 @@ class VerificationScheduler:
         (submitting from either would deadlock: they are the consumers)
         and once the scheduler is down or draining — callers fall back to
         the direct engine path."""
-        if threading.current_thread() in (self._thread, self._resolve_thread):
+        if threading.current_thread() in (
+            self._thread,
+            self._resolve_thread,
+            self._prefetch_thread,
+        ):
             return False
         with self._lock:
             return self._dead is None and not self._closed
@@ -911,11 +969,16 @@ class VerificationScheduler:
             }
             dead = self._dead
             inflight = len(self._resolve_q) + (1 if self._resolving else 0)
+            prefetch_pending = len(self._prefetch_pending)
         alive = dead is None and self._thread.is_alive()
         if self._resolve_thread is not None:
             # a dead resolve worker is just as fatal as a dead executor:
             # dispatched handles would never complete
             alive = alive and self._resolve_thread.is_alive()
+        if self._prefetch_thread is not None:
+            # same for the prefetch worker: pending batches would never
+            # get plans and the executor would wait on them forever
+            alive = alive and self._prefetch_thread.is_alive()
         mesh = self._pool.state() if self._pool is not None else None
         if mesh is not None:
             # any dead device lane means routed batches would never
@@ -936,6 +999,12 @@ class VerificationScheduler:
             "tenant_quota": self.config.tenant_quota,
             "pipeline_depth": self._pipe_depth,
             "pipeline_inflight": inflight,
+            # the 4th stage's EFFECTIVE state: the scheduler's own worker,
+            # or (mesh mode) the per-lane prefetch the pool runs instead —
+            # healthz must not say "off" while every lane prefetches
+            "prefetch": self._prefetch_on
+            or bool(mesh is not None and mesh.get("prefetch")),
+            "prefetch_pending": prefetch_pending,
         }
         if mesh is not None:
             out["mesh"] = mesh
@@ -954,6 +1023,11 @@ class VerificationScheduler:
         st["pipeline_depth"] = self._pipe_depth
         if self._pool is not None:
             st["mesh"] = self._pool.stats()
+            # mesh mode runs the prefetch stage per LANE (the scheduler's
+            # own worker is off) — fold the pool's count into the
+            # top-level stat so `prefetched_batches` answers "did the 4th
+            # stage run" the same way in every deployment shape
+            st["prefetched_batches"] += st["mesh"]["prefetched_batches"]
         return st
 
     def inflight_state(self) -> Optional[dict]:
@@ -988,6 +1062,8 @@ class VerificationScheduler:
         self._thread.join(timeout)
         if self._resolve_thread is not None:
             self._resolve_thread.join(timeout)
+        if self._prefetch_thread is not None:
+            self._prefetch_thread.join(timeout)
         if self._pool is not None:
             # the executor's graceful exit already drained every lane
             # (_drain_pipeline); this stops the lane threads
@@ -1001,7 +1077,18 @@ class VerificationScheduler:
         batch: List[_Job] = []
         try:
             while True:
-                batch = self._next_batch()
+                if self._prefetch_on:
+                    step = self._next_step_prefetching()
+                    if step == "loop":
+                        continue
+                    if isinstance(step, dict):
+                        batch = step["jobs"]
+                        self._execute_prefetched(step)
+                        batch = []
+                        continue
+                    batch = step  # serial batch or None (exit)
+                else:
+                    batch = self._next_batch()
                 if batch is None:
                     # graceful exit: every dispatched handle must resolve
                     # before the executor reports done (shutdown drains the
@@ -1018,6 +1105,343 @@ class VerificationScheduler:
 
     _exec_done = False  # executor returned cleanly (resolve worker exits)
 
+    # -- 4th pipeline stage: the prefetch worker (PR 9) ----------------------
+
+    def _next_step_prefetching(self) -> object:
+        """One executor decision under the 4-stage pipeline: top up the
+        prefetch lookahead from the admission queue, or consume the
+        oldest planned batch. Returns "loop" (decision made, go again),
+        a pending item dict (execute it), a serial batch, or None
+        (graceful exit — pending is empty by then)."""
+        with self._lock:
+            has_serial = bool(self._serial_q)
+            can_assemble = any(self._lanes.values())
+            pending = len(self._prefetch_pending)
+            # a finished plan beats topping up — but only while the
+            # worker still has queued work (pending > 1). At pending == 1
+            # the top-up comes FIRST: it hands the worker its next batch
+            # before this thread blocks in the pipeline handoff, which is
+            # exactly the window the prefetch is meant to hide under
+            # (draining to empty here measured hidden_pct 87 -> 0: the
+            # worker idled through every handoff stall). The top-up is
+            # cheap even off-saturation — assembly breaks its coalescing
+            # wait the moment a plan turns ready below.
+            head_ready = pending > 1 and self._prefetch_pending[0]["ready"]
+            if self._dead is not None:
+                return None
+        if pending and (
+            has_serial
+            or head_ready
+            or not can_assemble
+            or pending >= self._prefetch_lookahead
+        ):
+            # oldest planned batch first: the serial lane preempts the
+            # QUEUE, never work already past admission — and pending must
+            # drain before a serial job gets exclusivity anyway
+            return self._pop_prefetched()
+        batch = self._next_batch(block=(pending == 0))
+        if batch is _NO_BATCH:
+            return "loop"  # queued work vanished (expiry); re-evaluate
+        if batch is None or batch[0].kind == _SERIAL:
+            # pending was empty at the snapshot, but a close() or a
+            # serial arrival can RACE the two lock windows — and both
+            # graceful exit and serial exclusivity require the planned
+            # batches executed first (their futures would otherwise
+            # strand). Push a raced serial head back (index 0 keeps it
+            # the serial queue's head — admission order holds) and
+            # drain the oldest plan; the next pass re-takes the serial
+            # job / the exit with pending truly empty.
+            with self._lock:
+                raced = bool(self._prefetch_pending)
+                if raced and batch is not None:
+                    self._serial_q.insert(0, batch[0])
+            if raced:
+                return self._pop_prefetched()
+            return batch
+        self._submit_prefetch(batch)
+        return "loop"
+
+    def _submit_prefetch(self, batch: List[_Job]) -> None:
+        """Hand one assembled witness batch to the prefetch worker: the
+        batch enters the flight list NOW (stage="prefetch" — the obs
+        watchdog and stall records see the 4th stage), and the executor
+        picks the plan up in FIFO order once the worker finishes it."""
+        now = time.monotonic()
+        for j in batch:
+            metrics.observe_hist("sched.queue_wait_seconds", now - j.admitted)
+        if self.config.deadline_ms > 0:
+            stall_deadline: Optional[float] = now + self.config.deadline_ms / 1e3
+        else:
+            stall_deadline = None
+        trace_ids = [j.trace_id for j in batch]
+        item = {
+            "jobs": batch,
+            # the SAME list object goes to prefetch_batch and begin_batch:
+            # plan identity is how the engine knows the plan matches
+            "witnesses": [(j.root, j.nodes) for j in batch],
+            "picked": now,
+            "plan": None,
+            "ready": False,
+        }
+        with self._lock:
+            self._batch_seq += 1
+            item["batch_id"] = batch_id = self._batch_seq
+            self._inflight_list.append(
+                {
+                    "batch_id": batch_id,
+                    "lane": _WITNESS,
+                    "stage": "prefetch",
+                    "device": None,
+                    "started": now,
+                    "deadline": stall_deadline,
+                    "trace_ids": trace_ids,
+                }
+            )
+            self._prefetch_q.append(item)
+            self._prefetch_pending.append(item)
+            depth = len(self._prefetch_pending)
+            self._cond.notify_all()
+        metrics.gauge_set("sched.prefetch_depth", depth)
+        flight.record(
+            "sched.batch_start",
+            batch_id=batch_id,
+            lane=_WITNESS,
+            stage="prefetch",
+            batch_size=len(batch),
+            bucket_bytes=batch[0].bucket,
+            tenants=sorted({j.tenant for j in batch}),
+            trace_ids=trace_ids,
+        )
+
+    def _pop_prefetched(self) -> dict:
+        """The oldest pending batch, once its plan is ready. The wait here
+        is the overlap audit: time the executor spends blocked on a plan
+        is prefetch cost that did NOT hide under dispatch/resolve
+        (sched.prefetch_wait vs the witness_engine.prefetch phase)."""
+        t0 = time.perf_counter()
+        with self._lock:
+            # _die may have emptied _prefetch_pending between the
+            # caller's pending>0 check and this lock acquisition — the
+            # combined condition re-checks emptiness so a crash lands on
+            # the SchedulerDown below, not an IndexError
+            while self._dead is None and not (
+                self._prefetch_pending and self._prefetch_pending[0]["ready"]
+            ):
+                self._cond.wait(0.05)
+            dead = self._dead
+            if dead is None:
+                item = self._prefetch_pending.pop(0)
+                depth = len(self._prefetch_pending)
+        metrics.observe("sched.prefetch_wait", time.perf_counter() - t0)
+        if dead is not None:
+            raise SchedulerDown(f"prefetch worker is down: {dead!r}")
+        metrics.gauge_set("sched.prefetch_depth", depth)
+        return item
+
+    def _execute_prefetched(self, item: dict) -> None:
+        """Pack + dispatch one PREFETCHED batch (its flight descriptor and
+        batch_start record exist since _submit_prefetch): the 4-stage
+        twin of _execute_witness_pipelined, consuming the worker's plan
+        so pack's under-lock work shrinks to the re-check + commit."""
+        batch_id = item["batch_id"]
+        self._exec_stage = "pack"
+        with self._lock:
+            for d in self._inflight_list:
+                if d["batch_id"] == batch_id:
+                    d["stage"] = "pack"
+        plan = item["plan"]
+        try:
+            self._execute_prefetched_inner(item, plan)
+        except BaseException:
+            # an exception leaving this frame lands in _die, which can no
+            # longer see this item (popped from _prefetch_pending): give
+            # the plan's staging leases back before propagating. release()
+            # is idempotent and consumption nulls the plan's lease fields,
+            # so a plan begin_batch already consumed/released is a no-op.
+            if plan is not None:
+                plan.release()
+            raise
+
+    def _execute_prefetched_inner(self, item: dict, plan) -> None:
+        batch_id = item["batch_id"]
+        jobs = self._shed_or_keep(item["jobs"], time.monotonic())
+        if self._chaos_crash:
+            raise RuntimeError(
+                "chaos drill: PHANT_SCHED_CHAOS_CRASH=1 induced executor crash"
+            )
+        engine = self._resolve_engine()
+        if not jobs or not (
+            self._pipe_depth > 1 and hasattr(engine, "begin_batch")
+        ):
+            # everything expired, or a begin-less engine double: release
+            # the unused plan's staging leases and (if any jobs survive)
+            # fall back to the inline path — _execute_witness IS that
+            # path (one copy; its re-shed of already-kept jobs is a no-op
+            # and its chaos check is unreachable past the one above)
+            if plan is not None:
+                plan.release()
+            if jobs:
+                with self._lock:
+                    for d in self._inflight_list:
+                        if d["batch_id"] == batch_id:
+                            d["stage"] = "dispatch"
+                self._execute_witness(jobs, batch_id, engine, item["picked"])
+            with self._lock:
+                self._drop_inflight_locked(batch_id)
+            return
+        self._pipeline_handoff(
+            jobs,
+            batch_id,
+            engine,
+            item["picked"],
+            plan=plan,
+            prefetch_ms=item.get("prefetch_ms"),
+            plan_witnesses=item["witnesses"],
+            plan_njobs=len(item["jobs"]),
+        )
+
+    def _pipeline_handoff(
+        self,
+        jobs: List[_Job],
+        batch_id: int,
+        engine,
+        picked: float,
+        plan=None,
+        prefetch_ms: Optional[float] = None,
+        plan_witnesses=None,
+        plan_njobs: int = 0,
+    ) -> None:
+        """Shared tail of the pipelined witness paths (3- and 4-stage):
+        wait for a pipeline slot, re-shed expired jobs, begin_batch —
+        consuming the prefetch plan when one rode along — and hand the
+        handle to the resolve worker. The bounded depth is the stall
+        signal: a hot resolve stage shows up as sched.pipeline_stall."""
+        depth = self._pipe_depth
+        t_wait = time.perf_counter()
+        with self._lock:
+            while (
+                len(self._resolve_q) + (1 if self._resolving else 0) >= depth
+                and self._dead is None
+            ):
+                self._cond.wait(0.05)
+            dead = self._dead
+        metrics.observe("sched.pipeline_stall", time.perf_counter() - t_wait)
+        if dead is not None:
+            # the resolve worker died while we waited: fail this batch the
+            # same way _die failed everything else, and stop the executor
+            if plan is not None:
+                plan.release()
+            raise SchedulerDown(f"resolve worker is down: {dead!r}")
+        # deadlines re-checked AFTER the slot wait: a wedged resolve stage
+        # can hold the pipeline full long past a job's deadline, and an
+        # expired job must shed (its waiter is gone) rather than spend
+        # pack/dispatch/resolve work
+        jobs = self._shed_or_keep(jobs, time.monotonic())
+        if not jobs:
+            if plan is not None:
+                plan.release()
+            with self._lock:
+                self._drop_inflight_locked(batch_id)
+            return
+        if plan_witnesses is not None and len(jobs) == plan_njobs:
+            # the SAME list object the plan was computed over — identity
+            # is how begin_batch knows the plan matches; any shed along
+            # the way invalidates it and begin_batch drops it, correctly
+            witnesses = plan_witnesses
+        else:
+            witnesses = [(j.root, j.nodes) for j in jobs]
+        t_pack = time.perf_counter()
+        if plan is not None:
+            handle = engine.begin_batch(witnesses, prefetch=plan)
+        else:
+            handle = engine.begin_batch(witnesses)
+        pipe_item = {
+            "jobs": jobs,
+            "handle": handle,
+            "batch_id": batch_id,
+            "picked": picked,
+            "pack_ms": round((time.perf_counter() - t_pack) * 1e3, 3),
+        }
+        if prefetch_ms is not None:
+            pipe_item["prefetch_ms"] = prefetch_ms
+        with self._lock:
+            dead = self._dead
+            if dead is None:
+                self._resolve_q.append(pipe_item)
+        if dead is not None:
+            # the worker died while we packed: the just-begun handle will
+            # never be resolved — release its engine lease before failing
+            _abandon_handle(engine, handle)
+            raise SchedulerDown(f"resolve worker is down: {dead!r}")
+        with self._lock:
+            self.stats["pipelined_batches"] += 1
+            inflight = len(self._resolve_q) + (1 if self._resolving else 0)
+            self._cond.notify_all()
+        metrics.gauge_set("sched.pipeline_inflight", inflight)
+
+    def _prefetch_run(self) -> None:
+        """The prefetch worker: witness decode + advisory novelty
+        pre-scan for each assembled batch (ops/witness_engine.py
+        prefetch_batch — lock-free against the committed tables), while
+        the executor packs/dispatches earlier batches and the resolve
+        worker resolves still-earlier ones. A crash here is systemic
+        (_die, stage="prefetch"): in-flight work fails fast with -32052,
+        exactly like the other stages."""
+        item: Optional[dict] = None
+        try:
+            while True:
+                with self._lock:
+                    while (
+                        not self._prefetch_q
+                        and not self._exec_done
+                        and self._dead is None
+                    ):
+                        self._cond.wait()
+                    if self._dead is not None:
+                        return  # _die already failed everything queued
+                    if not self._prefetch_q:
+                        return  # executor done; pending is drained
+                    item = self._prefetch_q.pop(0)
+                if self._chaos_prefetch:
+                    raise RuntimeError(
+                        "chaos drill: PHANT_SCHED_CHAOS_CRASH=prefetch "
+                        "induced prefetch-stage crash"
+                    )
+                engine = self._resolve_engine()
+                pf = getattr(engine, "prefetch_batch", None)
+                plan = None
+                if pf is not None:
+                    t0 = time.perf_counter()
+                    plan = pf(item["witnesses"])
+                    pf_ms = round((time.perf_counter() - t0) * 1e3, 3)
+                with self._lock:
+                    orphaned = self._dead is not None
+                    if not orphaned:
+                        item["plan"] = plan
+                        if pf is not None:
+                            # a prefetch-less engine double still flows
+                            # through the worker, but nothing was decoded
+                            # or pre-scanned — stats/metrics must not
+                            # report a 4th stage that never ran
+                            item["prefetch_ms"] = pf_ms
+                            self.stats["prefetched_batches"] += 1
+                        item["ready"] = True
+                        self._cond.notify_all()
+                if orphaned:
+                    # _die ran while this plan was computing: it cleared
+                    # _prefetch_pending and saw plan=None on this item,
+                    # so nobody else will release these staging leases —
+                    # drop them back to the pool here, or the shared
+                    # engine's _staging loses them for good
+                    if plan is not None:
+                        plan.release()
+                    return
+                if pf is not None:
+                    metrics.count("sched.prefetch_batches")
+                item = None
+        except BaseException as e:  # systemic: prefetch-stage failure
+            self._die(e, item["jobs"] if item else [], stage="prefetch")
+
     def _drain_pipeline(self) -> None:
         """Block until every dispatched handle has resolved (or the
         scheduler died). Called by the executor before serial jobs —
@@ -1031,7 +1455,7 @@ class VerificationScheduler:
         if self._pool is not None:
             self._pool.drain()
 
-    def _next_batch(self) -> Optional[List[_Job]]:
+    def _next_batch(self, block: bool = True):
         with self._lock:
             while True:
                 self._expire_locked()
@@ -1043,6 +1467,10 @@ class VerificationScheduler:
                     break
                 if self._closed:
                     return None
+                if not block:
+                    # prefetching executor with planned batches pending:
+                    # it must not idle here while a ready plan waits
+                    return _NO_BATCH
                 self._cond.wait()
             if self._serial_q:
                 # priority order: the serial mutation lane (head-of-chain
@@ -1122,6 +1550,13 @@ class VerificationScheduler:
                 if len(batch) >= self._max_batch:
                     break
             if len(batch) >= self._max_batch or self._closed:
+                break
+            if self._prefetch_pending and self._prefetch_pending[0]["ready"]:
+                # 4-stage pipeline: a finished plan is waiting on this
+                # thread — dispatching it beats further coalescing here
+                # (waiting out the window would serialize the whole
+                # pipeline behind one batch's assembly, the exact
+                # bubble the prefetch stage exists to remove)
                 break
             # the wait window shrinks as the queue deepens (a full
             # backlog needs no coalescing delay) and is re-evaluated
@@ -1388,55 +1823,7 @@ class VerificationScheduler:
             raise RuntimeError(
                 "chaos drill: PHANT_SCHED_CHAOS_CRASH=1 induced executor crash"
             )
-        # bounded depth: wait for a pipeline slot (stall time is the
-        # occupancy signal — a hot resolve stage shows up here). The depth
-        # is an immutable config scalar, read lock-free like the others.
-        depth = self._pipe_depth
-        t_wait = time.perf_counter()
-        with self._lock:
-            while (
-                len(self._resolve_q) + (1 if self._resolving else 0) >= depth
-                and self._dead is None
-            ):
-                self._cond.wait(0.05)
-            dead = self._dead
-        metrics.observe("sched.pipeline_stall", time.perf_counter() - t_wait)
-        if dead is not None:
-            # the resolve worker died while we waited: fail this batch the
-            # same way _die failed everything else, and stop the executor
-            raise SchedulerDown(f"resolve worker is down: {dead!r}")
-        # deadlines re-checked AFTER the slot wait: a wedged resolve stage
-        # can hold the pipeline full long past a job's deadline, and an
-        # expired job must shed (its waiter is gone) rather than spend
-        # pack/dispatch/resolve work
-        jobs = self._shed_or_keep(jobs, time.monotonic())
-        if not jobs:
-            with self._lock:
-                self._drop_inflight_locked(batch_id)
-            return
-        t_pack = time.perf_counter()
-        handle = engine.begin_batch([(j.root, j.nodes) for j in jobs])
-        item = {
-            "jobs": jobs,
-            "handle": handle,
-            "batch_id": batch_id,
-            "picked": picked,
-            "pack_ms": round((time.perf_counter() - t_pack) * 1e3, 3),
-        }
-        with self._lock:
-            dead = self._dead
-            if dead is None:
-                self._resolve_q.append(item)
-        if dead is not None:
-            # the worker died while we packed: the just-begun handle will
-            # never be resolved — release its engine lease before failing
-            _abandon_handle(engine, handle)
-            raise SchedulerDown(f"resolve worker is down: {dead!r}")
-        with self._lock:
-            self.stats["pipelined_batches"] += 1
-            inflight = len(self._resolve_q) + (1 if self._resolving else 0)
-            self._cond.notify_all()
-        metrics.gauge_set("sched.pipeline_inflight", inflight)
+        self._pipeline_handoff(jobs, batch_id, engine, picked)
 
     # -- mesh dispatch (mesh_devices >= 1, serving/mesh_exec.py) -------------
 
@@ -1639,6 +2026,8 @@ class VerificationScheduler:
             handle, item["batch_id"], len(jobs), jobs[0].bucket
         )
         record["pack_ms"] = item["pack_ms"]
+        if "prefetch_ms" in item:
+            record["prefetch_ms"] = item["prefetch_ms"]
         record["resolve_ms"] = round((time.monotonic() - t0) * 1e3, 3)
         self._finish_witness_jobs(jobs, verdicts, record, item["picked"])
 
@@ -1677,9 +2066,18 @@ class VerificationScheduler:
             dropped_items = list(self._resolve_q)
             for item in dropped_items:
                 victims.extend(item["jobs"])
+            # batches mid-prefetch (queued for the worker or awaiting
+            # pickup) fail fast too; their plans' staging leases release
+            # outside the lock. The crashing batch may still sit in
+            # _prefetch_pending — _safe_fail tolerates the double-fail.
+            dropped_plans = list(self._prefetch_pending)
+            for item in dropped_plans:
+                victims.extend(item["jobs"])
             self._serial_q = []
             self._lanes = {}
             self._resolve_q = []
+            self._prefetch_q = []
+            self._prefetch_pending = []
             self._inflight_list = []
             batch_id = self._batch_seq
             self._cond.notify_all()
@@ -1688,6 +2086,13 @@ class VerificationScheduler:
             # never resolved, never will be: release the engine leases so
             # a shared engine keeps evicting after this scheduler's death
             _abandon_handle(engine, item["handle"])
+        for item in dropped_plans:
+            plan = item.get("plan")
+            if plan is not None:
+                try:
+                    plan.release()  # unconsumed staging leases -> pool
+                except Exception:
+                    log.warning("plan release failed on a crash path", exc_info=True)
         pool_failed = 0
         if self._pool is not None:
             # queued-but-unbegun mesh batches fail fast here; lanes

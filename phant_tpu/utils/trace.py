@@ -143,6 +143,7 @@ METRIC_HELP: Dict[str, str] = {
     "stateless.errors": "Stateless executions aborted, by exception kind",
     "stateless.witness_verify": "Linked-multiproof witness verification phase",
     "stateless.witness_decode": "Witness -> WitnessStateDB materialization phase",
+    "stateless.witness_nodes_decoded": "Witness nodes decoded (digest map built) on the request path — exactly once per payload; a doubled count per payload is a reintroduced second decode",
     "stateless.execute": "Block execution phase over the witness-backed state",
     "stateless.post_root": "Post-state-root recompute phase over the partial trie",
     # memoized witness engine
@@ -150,14 +151,17 @@ METRIC_HELP: Dict[str, str] = {
     "witness_engine.interned_digests": "Unique 32-byte digests currently interned (nodes + child refs)",
     "witness_engine.cache_hits": "Witness nodes served from the interning cache",
     "witness_engine.cache_misses": "Witness nodes that had to be hashed (novel nodes)",
-    "witness_engine.evictions": "Generation flushes of the interned set (max_nodes crossed)",
+    "witness_engine.evictions": "Generation flushes of the interned set (max_nodes crossed), by tier: deep = shallow pins retained, only deeper tiers evicted; full = everything dropped; twin = python-twin-only flush on a C-core engine",
     "witness_engine.novel_bytes_hashed": "Bytes of novel witness nodes hashed",
     "witness_engine.verify_batch": "Whole verify_batch calls (scan + hash + linkage)",
     "witness_engine.intern": "Interning/scan phase of verify_batch (cache probe + table insert)",
     "witness_engine.hash": "Novel-node keccak phase of verify_batch (includes the C-side commit+join on the finish_native fast path)",
     "witness_engine.linkage_join": "Parent->child linkage join / verdict phase of verify_batch",
     # pipelined two-phase engine API (begin_batch/resolve_batch)
-    "witness_engine.pack": "Pack stage: host batch assembly + lock-held intern-table scan (begin_batch)",
+    "witness_engine.prefetch": "Prefetch stage: witness decode + advisory novelty pre-scan + staging pre-fill for the NEXT batch, off the serving critical path (prefetch_batch)",
+    "witness_engine.prefetch_plan_hits": "Prefetch plans whose candidate-novel set the authoritative pack-time scan confirmed (staging leases reused)",
+    "witness_engine.prefetch_plan_stale": "Prefetch plans dropped stale at pack time (concurrent commit / generation flush) — a perf miss, never a correctness event",
+    "witness_engine.pack": "Pack stage: host batch assembly + lock-held intern-table scan (begin_batch); with a prefetch plan, the under-lock re-check + commit only",
     "witness_engine.dispatch": "Dispatch stage: device keccak enqueue of the novel nodes, no host sync (begin_batch)",
     "witness_engine.resolve": "Resolve stage: digest readback/hash outside the lock + commit + linkage join (resolve_batch)",
     # cache_hit_rate vs trie_depth (PHANT_DEPTH_HIST=1): per-depth scan
@@ -187,6 +191,10 @@ METRIC_HELP: Dict[str, str] = {
     "sched.pipeline_depth": "Configured pipeline depth (1 = serialized pack/dispatch/resolve, the pre-pipeline behavior)",
     "sched.pipeline_inflight": "Witness batches currently between begin_batch and resolve_batch",
     "sched.pipeline_stall": "Executor waits for a free pipeline slot (resolve stage is the bottleneck)",
+    # 4th pipeline stage: the prefetch worker (PR 9)
+    "sched.prefetch_batches": "Witness batches whose decode + novelty pre-scan ran on the prefetch stage (scheduler worker or mesh lane) before pack",
+    "sched.prefetch_wait": "Executor waits for a batch's prefetch plan — prefetch cost that did NOT hide under dispatch/resolve (the overlap audit against the witness_engine.prefetch phase)",
+    "sched.prefetch_depth": "Assembled witness batches currently waiting on the prefetch worker (the lookahead occupancy)",
     # mesh-sharded dispatch (phant_tpu/serving/mesh_exec.py)
     "sched.mesh_devices": "Device lanes in the mesh executor pool (--sched-mesh)",
     "sched.device_queue_depth": "Witness batches queued on a mesh device lane, by device",

@@ -88,6 +88,13 @@ def _direction(key: str) -> Optional[str]:
         # the slope/baseline ratio itself (a shrinking ratio is the
         # headline regressing even if both rates moved)
         return "up"
+    if key.endswith("_hit_rate") or key.endswith("_hidden_pct"):
+        # witness_stream (round 9): steady-state intern hit rate under
+        # depth-tiered eviction, and the fraction of prefetch decode +
+        # pre-scan time hidden under dispatch/resolve — both shrinking
+        # means the streaming-ingestion win is regressing (the overlap
+        # speedup itself trend-gates via the _per_sec keys above)
+        return "up"
     if _PCTL_RE.search(key):
         return "down"
     if key.endswith("_ms") or key.endswith("_seconds") or key.endswith("_s"):

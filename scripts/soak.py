@@ -380,13 +380,70 @@ def _pipeline_phase() -> int:
                 f"{crashes[-1] if crashes else None}"
             )
 
+    # prefetch-stage drill (PR 9): the 4th stage dies mid-decode — only
+    # in-flight work fails (-32052) and the dump names the PREFETCH stage
+    class _PoisonedPrefetch(_PoisonedResolve):
+        def prefetch_batch(self, w):
+            if self.armed:
+                raise RuntimeError("soak-induced prefetch crash")
+            return self._eng.prefetch_batch(w)
+
+        def begin_batch(self, w, prefetch=None):
+            return self._eng.begin_batch(w, prefetch=prefetch)
+
+        def resolve_batch(self, h):
+            return self._eng.resolve_batch(h)
+
+    before = set(os.listdir(flight_dir)) if os.path.isdir(flight_dir) else set()
+    poisoned = _PoisonedPrefetch()
+    s = VerificationScheduler(
+        engine=poisoned,
+        config=SchedulerConfig(
+            max_batch=8, max_wait_ms=5.0, pipeline_depth=2, prefetch=True
+        ),
+    )
+    try:
+        first = [s.submit_witness(*w) for w in wits[16:24]]
+        if not all(f.result(timeout=30) for f in first):
+            failures.append("pre-crash batch not VALID (prefetch drill)")
+        poisoned.armed = True
+        second = [s.submit_witness(*w) for w in wits[24:32]]
+        for f in second:
+            try:
+                f.result(timeout=30)
+                failures.append("in-flight handle survived prefetch crash")
+            except SchedulerDown as e:
+                if e.code != -32052:
+                    failures.append(f"wrong down code (prefetch): {e.code}")
+        if not all(f.result(timeout=1) for f in first):
+            failures.append("resolved verdicts lost after prefetch crash")
+    finally:
+        s.shutdown()
+    new_dumps = sorted(set(os.listdir(flight_dir)) - before)
+    crash_dumps = [d for d in new_dumps if "executor_crash" in d]
+    if not crash_dumps:
+        failures.append(f"no prefetch-crash flight dump ({new_dumps})")
+    else:
+        with open(os.path.join(flight_dir, crash_dumps[-1])) as f:
+            dump = json.load(f)
+        crashes = [
+            r for r in dump.get("records", [])
+            if r.get("kind") == "sched.executor_crash"
+        ]
+        if not crashes or crashes[-1].get("stage") != "prefetch":
+            failures.append(
+                f"crash dump does not name the prefetch stage: "
+                f"{crashes[-1] if crashes else None}"
+            )
+
     if failures:
         for f in failures:
             print(f"[soak] FAIL (pipeline phase): {f}", file=sys.stderr)
         return 1
     print(
-        "[soak] pipeline phase green: depth-2 byte-identical, resolve-stage "
-        "crash fails only in-flight handles and names its stage"
+        "[soak] pipeline phase green: depth-2 byte-identical, resolve- and "
+        "prefetch-stage crashes fail only in-flight handles and name "
+        "their stages"
     )
     return 0
 

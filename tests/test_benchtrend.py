@@ -350,3 +350,35 @@ def test_witness_resident_slope_regression_flags(tmp_path):
     assert any(
         "witness_fused_resident_slope_blocks_per_sec" in f for f in flags
     )
+
+
+def test_witness_stream_key_directions():
+    """Round-9 `witness_stream` section keys: the prefetch-on/off serving
+    rates trend via the `_per_sec` suffix, the steady-state hit rates
+    and the hidden-decode fraction are higher-is-better (a shrinking hit
+    rate is the tiered-eviction win regressing; a shrinking hidden
+    fraction means the prefetch decode fell back onto the critical
+    path), and shape echoes stay informational. Pinned so a
+    direction-suffix rework cannot silently un-gate the PR 9 claims."""
+    d = benchtrend._direction
+    assert d("witness_stream_prefetch_on_blocks_per_sec") == "up"
+    assert d("witness_stream_prefetch_off_blocks_per_sec") == "up"
+    assert d("witness_stream_tiered_hit_rate") == "up"
+    assert d("witness_stream_flat_hit_rate") == "up"
+    assert d("witness_stream_prefetch_hidden_pct") == "up"
+    # echoes/accounting: never flagged as perf regressions
+    assert d("witness_stream_blocks") is None
+    assert d("witness_stream_prefetch_overlap_pct") is None
+    assert d("witness_stream_noise_aa_pct") is None
+    assert d("witness_stream_cap") is None
+
+
+def test_witness_stream_hit_rate_regression_flags(tmp_path):
+    """A collapsed tiered steady-state hit rate must flag: it is the
+    eviction-policy acceptance number (flat-flush behavior creeping
+    back would show exactly this signature)."""
+    for n, rate in enumerate([0.97, 0.96, 0.97], start=1):
+        _write_round(tmp_path, n, {"witness_stream_tiered_hit_rate": rate})
+    _write_round(tmp_path, 4, {"witness_stream_tiered_hit_rate": 0.41})
+    rows, flags = benchtrend.analyze(str(tmp_path), 0.4, 2)
+    assert any("witness_stream_tiered_hit_rate" in f for f in flags)

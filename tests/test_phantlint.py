@@ -664,15 +664,18 @@ def spin(items):
 def test_jnp_in_host_loop_mutation_turns_gate_red(mutated_tree, monkeypatch):
     """Acceptance mutation: introducing a per-iteration jnp call into a
     host loop on the pipeline path makes the gate red with a JNPHOSTLOOP
-    finding at the loop's call site."""
+    finding at the loop's call site. The anchor is the prefetch stage's
+    per-witness assembly loop (_prefetch_plan) — the first occurrence of
+    the pattern, and exactly where a stray device call would re-serialize
+    the 4th stage."""
     p = mutated_tree / "phant_tpu" / "ops" / "witness_engine.py"
     src = p.read_text()
     mutated = src.replace(
-        "            for b, (_root, nodes) in enumerate(witnesses):\n"
-        "                counts[b] = len(nodes)\n",
-        "            import jax.numpy as jnp\n"
-        "            for b, (_root, nodes) in enumerate(witnesses):\n"
-        "                counts[b] = jnp.asarray(len(nodes))\n",
+        "        for b, (_root, nodes) in enumerate(witnesses):\n"
+        "            counts[b] = len(nodes)\n",
+        "        import jax.numpy as jnp\n"
+        "        for b, (_root, nodes) in enumerate(witnesses):\n"
+        "            counts[b] = jnp.asarray(len(nodes))\n",
         1,
     )
     assert mutated != src
@@ -927,5 +930,40 @@ def test_resident_dispatch_is_in_hostsync_scope(mutated_tree, monkeypatch):
         if f.rule == "HOSTSYNC"
         and ".item()" in f.message
         and "witness_resident" in f.path
+    ]
+    assert hits, [f.render() for f in res.new]
+
+
+def test_prefetch_prescan_is_in_hostsync_scope(mutated_tree, monkeypatch):
+    """The PR 9 prefetch stage is HOSTSYNC-scoped: the 4th pipeline
+    stage exists to take work OFF the serving critical path, so a
+    reintroduced device-scalar pull in the pre-scan (or anything it
+    reaches) must turn the gate red."""
+    from phant_tpu.analysis.rules.hostsync import DEFAULT_ENTRIES
+
+    assert (
+        "phant_tpu.ops.witness_engine.WitnessEngine.prefetch_batch"
+        in DEFAULT_ENTRIES
+    )
+    assert (
+        "phant_tpu.serving.scheduler.VerificationScheduler._prefetch_run"
+        in DEFAULT_ENTRIES
+    )
+    p = mutated_tree / "phant_tpu" / "ops" / "witness_engine.py"
+    src = p.read_text()
+    mutated = src.replace(
+        "        plan.novel = novel\n",
+        "        _sync = counts.sum().item()\n        plan.novel = novel\n",
+        1,
+    )
+    assert mutated != src
+    p.write_text(mutated)
+    res = _analyze_repo_tree(mutated_tree, monkeypatch)
+    hits = [
+        f
+        for f in res.new
+        if f.rule == "HOSTSYNC"
+        and ".item()" in f.message
+        and "witness_engine" in f.path
     ]
     assert hits, [f.render() for f in res.new]

@@ -895,10 +895,18 @@ def test_pipeline_batch_records_carry_stage():
         recs = flight.records()
     starts = [r for r in recs if r.get("kind") == "sched.batch_start"]
     dones = [r for r in recs if r.get("kind") == "sched.batch_done"]
-    assert any(r.get("stage") == "pack" for r in starts), starts[-3:]
+    # with the 4-stage pipeline (prefetch on, the depth>=2 default) a
+    # witness batch enters flight at the PREFETCH stage; --sched-prefetch 0
+    # keeps the 3-stage pack entry
+    assert any(
+        r.get("stage") in ("pack", "prefetch") for r in starts
+    ), starts[-3:]
     piped = [r for r in dones if r.get("stage") == "resolve"]
     assert piped, dones[-3:]
     assert "pack_ms" in piped[-1] and "resolve_ms" in piped[-1]
+    if any(r.get("stage") == "prefetch" for r in starts):
+        # the plan's decode+pre-scan time rides the batch record too
+        assert "prefetch_ms" in piped[-1], piped[-1]
 
 
 def test_cli_pipeline_depth_flag():

@@ -538,7 +538,10 @@ def test_deferred_eviction_runs_at_resolve_drain(setup):
     forever and grow the tables without bound."""
     _trie, _keys, root, witnesses = setup
     cap = len({n for _r, nodes in witnesses[:9] for n in nodes})
-    eng = WitnessEngine(max_nodes=cap)
+    # tiered_evict=False: this test pins the flush-at-drain TIMING and
+    # asserts the FLAT flush's empty fresh generation; the tiered
+    # flush's pinned retention is pinned by tests/test_witness_stream.py
+    eng = WitnessEngine(max_nodes=cap, tiered_evict=False)
     h0 = eng.begin_batch(witnesses[:6])
     assert eng.resolve_batch(h0).all()
     h1 = eng.begin_batch(witnesses[6:9])  # fills to the cap exactly
