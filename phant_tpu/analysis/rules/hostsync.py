@@ -60,6 +60,14 @@ DEFAULT_ENTRIES: Tuple[str, ...] = (
     # silently turns the overlap win into a stall)
     "phant_tpu.ops.witness_engine.WitnessEngine.prefetch_batch",
     "phant_tpu.serving.scheduler.VerificationScheduler._prefetch_run",
+    # batched post-state roots (PR 11): plan lowering (the merge the
+    # prefetch stage runs) and the root_many dispatch path exist to
+    # enqueue the merged program with ZERO host sync — a reintroduced
+    # `.item()`/readback in the level loop puts a blocking round trip
+    # back on every coalesced post root (the resolve stage's honest
+    # readback is annotated)
+    "phant_tpu.ops.root_engine.RootEngine.prefetch_batch",
+    "phant_tpu.ops.root_engine.RootEngine.root_many",
 )
 
 _SCALAR_BUILTINS = ("int", "bool", "float")

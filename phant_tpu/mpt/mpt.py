@@ -377,11 +377,9 @@ def trie_root_hash(trie: Trie) -> bytes:
 
     Tiny tries (a handful of txs/receipts) stay on the host even on the tpu
     backend: per-level dispatch latency would dwarf the hashing. The
-    threshold is leaf-count based (PHANT_TPU_MIN_TRIE, default 192), and on
-    top of it the measured link profile must say the shipped bytes beat the
-    native hasher (phant_tpu/backend.py device_link_profile) — a tunneled
-    chip never qualifies for byte-dense hashing, so the flag cannot regress
-    the block path (round-2 demand: never slower than cpu end-to-end)."""
+    threshold is leaf-count based (PHANT_TPU_MIN_TRIE, default 192) on top
+    of THE offload-gate story (ops/root_engine.py module docstring — the
+    single source of truth for when plan bytes beat the native hasher)."""
     from phant_tpu.backend import crypto_backend, jax_device_ok
 
     if (
@@ -403,10 +401,10 @@ def _min_device_trie() -> int:
 
 
 def _device_root_pays(trie: Trie) -> bool:
-    """Link-aware offload gate for device trie roots: ship the plan only
-    when upload + round trip beats hashing the same bytes natively
-    (the shared cost model, phant_tpu/backend.py device_offload_pays).
-    Estimates ~600B per leaf (leaf + amortized branch encodings)."""
+    """Link-aware offload gate for device trie roots (THE offload-gate
+    story lives in ops/root_engine.py; this applies it with a ~600B/leaf
+    payload estimate — leaf + amortized branch encodings — through the
+    shared cost model, phant_tpu/backend.py device_offload_pays)."""
     import os
 
     if os.environ.get("PHANT_TPU_FORCE_TRIE", "0") not in ("", "0"):

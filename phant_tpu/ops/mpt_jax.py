@@ -22,7 +22,7 @@ from __future__ import annotations
 
 import functools
 from dataclasses import dataclass
-from typing import Dict, List, Optional, Tuple
+from typing import Dict, List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -53,9 +53,35 @@ def _list_header(payload_len: int) -> bytes:
     return bytes([0xF7 + len(ll)]) + ll
 
 
+def str_header(payload_len: int) -> bytes:
+    """RLP string header for a payload of `payload_len` >= 2 bytes (the
+    single-byte encodings below 0x80 never apply to the >=33-byte account
+    leaf values this is used for)."""
+    if payload_len < 56:
+        return bytes([0x80 + payload_len])
+    ll = payload_len.to_bytes((payload_len.bit_length() + 7) // 8, "big")
+    return bytes([0xB7 + len(ll)]) + ll
+
+
+class _ValueHole:
+    """A leaf VALUE carrying an embedded 32-byte hole: RLP-encodes as one
+    string item `prefix + <32 zero bytes> + suffix`, with the hole's byte
+    offset reported like a child-ref hole. This is how the fused post-root
+    plan wires an account leaf to its storage trie's root digest — the
+    storage root is a hole INSIDE the leaf's account-RLP value
+    (stateless.WitnessStateDB.post_root_plan)."""
+
+    __slots__ = ("prefix", "suffix")
+
+    def __init__(self, prefix: bytes, suffix: bytes):
+        self.prefix = prefix
+        self.suffix = suffix
+
+
 def _encode_template(items) -> Tuple[bytes, List[int]]:
     """RLP-encode a node whose child refs are 32-byte holes; returns the
-    encoding (holes zeroed) and each hole's byte offset."""
+    encoding (holes zeroed) and each hole's byte offset (in encounter
+    order — standalone `_HOLE` items and `_ValueHole` inner holes alike)."""
     payload = bytearray()
     holes: List[int] = []
     for it in items:
@@ -63,6 +89,13 @@ def _encode_template(items) -> Tuple[bytes, List[int]]:
             payload.append(0xA0)  # RLP string header for 32 bytes
             holes.append(len(payload))
             payload += b"\x00" * 32
+        elif isinstance(it, _ValueHole):
+            total = len(it.prefix) + 32 + len(it.suffix)
+            payload += str_header(total)
+            payload += it.prefix
+            holes.append(len(payload))
+            payload += b"\x00" * 32
+            payload += it.suffix
         else:
             payload += rlp.encode(it)
     header = _list_header(len(payload))
@@ -71,14 +104,18 @@ def _encode_template(items) -> Tuple[bytes, List[int]]:
 
 @dataclass
 class HashPlan:
-    """Per-level device layout for one trie.
+    """Per-level device layout for one (or one fused set of) trie(s).
 
     The plan is value-complete but hash-free: templates carry zeroed 32-byte
     holes where child digests go, so executing the plan re-derives EVERY
     node digest from raw bytes — caching a plan caches packing work, never
     hashes. `device_args` holds the plan's arrays already resident on the
     device (populated on first execution), so repeated roots of an unchanged
-    trie transfer nothing but the 32-byte result."""
+    trie transfer nothing but the 32-byte result.
+
+    `out_rows` lists the digest rows (in the PADDED per-level row space)
+    the caller wants back — the fused post-root plans read back each
+    storage root plus the account root; None means just the root."""
 
     blob: np.ndarray  # (L,) uint8 — all templates + gather/scatter slack
     # per level: offsets (n,), lens (n,), hole_pos (h,), hole_child (h,)
@@ -86,6 +123,204 @@ class HashPlan:
     n_nodes: int  # total real nodes
     root_pos: int  # row of the root digest in the global digest buffer
     device_args: Optional[tuple] = None  # (blob_d, levels_d) jax arrays
+    out_rows: Optional[np.ndarray] = None  # (R,) int32 padded-space rows
+
+
+class PlanBuilder:
+    """Shared post-order template walker behind `build_hash_plan` (full
+    tries) and the PartialTrie post-root planner (stateless.py).
+
+    Two extensions over the original full-trie walk make witness-shaped
+    (partial) tries plannable:
+
+      * a node exposing a `.digest` attribute (an unwitnessed HashNode
+        subtree) contributes its digest to the parent template as a
+        CONSTANT — no entry, no hashing: the untouched subtrees of a
+        witness enter the level blob as literal bytes;
+      * a LeafNode registered in `value_holes` encodes its value as a
+        `_ValueHole` — 32 zero bytes wired to another planned entry's
+        digest row — which is how one fused plan covers account AND
+        storage tries (the storage root is a hole in the account leaf).
+
+    `try_subtree` visits one trie with rollback: a subtree containing an
+    embedded (<32 B) or oversized node unwinds cleanly so the caller can
+    fall back to the host walk for THAT trie only."""
+
+    def __init__(self):
+        # (level, template, [(hole_off, child_gi)])
+        self.entries: List[Tuple[int, bytes, List[Tuple[int, int]]]] = []
+        self._index: Dict[int, int] = {}
+        self._order: List[int] = []  # node ids, parallel to entries
+        self.too_small = False
+        # id(LeafNode) -> (value_prefix, value_suffix, child_gi,
+        # child_level): the fused account+storage wiring
+        self.value_holes: Dict[int, Tuple[bytes, bytes, int, int]] = {}
+
+    def visit(self, node) -> Tuple[Optional[int], int, Optional[bytes]]:
+        """(entry_gi, level, const_digest). `const_digest` is set (and gi
+        is None, level 0) for digest-only nodes."""
+        dg = getattr(node, "digest", None)
+        if dg is not None:
+            return None, 0, dg
+        nid = id(node)
+        if nid in self._index:
+            gi = self._index[nid]
+            return gi, self.entries[gi][0], None
+        if isinstance(node, LeafNode):
+            vh = self.value_holes.get(nid)
+            if vh is not None:
+                prefix, suffix, child_gi, child_level = vh
+                template, holes = _encode_template(
+                    [encode_hex_prefix(node.path, True), _ValueHole(prefix, suffix)]
+                )
+                level = child_level + 1
+                hole_refs: List[Tuple[int, int]] = [(holes[0], child_gi)]
+            else:
+                template, _holes = _encode_template(
+                    [encode_hex_prefix(node.path, True), node.value]
+                )
+                level = 0
+                hole_refs = []
+        elif isinstance(node, ExtensionNode):
+            ci, clvl, cdg = self.visit(node.child)
+            if cdg is not None:
+                template, _holes = _encode_template(
+                    [encode_hex_prefix(node.path, False), cdg]
+                )
+                level = 0
+                hole_refs = []
+            else:
+                template, holes = _encode_template(
+                    [encode_hex_prefix(node.path, False), _HOLE]
+                )
+                level = clvl + 1
+                hole_refs = [(holes[0], ci)]
+        else:  # BranchNode
+            items: List = []
+            child_order: List[int] = []
+            level = -1
+            for child in node.children:
+                if child is None:
+                    items.append(b"")
+                    continue
+                ci, clvl, cdg = self.visit(child)
+                if cdg is not None:
+                    items.append(cdg)  # constant 32-byte digest ref
+                else:
+                    items.append(_HOLE)
+                    child_order.append(ci)
+                    level = max(level, clvl)
+            items.append(node.value if node.value is not None else b"")
+            template, holes = _encode_template(items)
+            level += 1  # -1 (all-constant children) -> level 0
+            hole_refs = list(zip(holes, child_order))
+        if len(template) < 32:
+            self.too_small = True
+        if len(template) > MPT_MAX_CHUNKS * RATE - 1:
+            self.too_small = True  # oversized node: CPU path
+        gi = len(self.entries)
+        self.entries.append((level, template, hole_refs))
+        self._index[nid] = gi
+        self._order.append(nid)
+        return gi, level, None
+
+    def try_subtree(self, node) -> Optional[Tuple[int, int]]:
+        """Visit one trie root; (gi, level), or None with the builder
+        rolled back when the subtree is unplannable (embedded/oversized
+        node, or a digest-only root)."""
+        mark = len(self.entries)
+        saved = self.too_small
+        self.too_small = False
+        gi, level, const = self.visit(node)
+        if self.too_small or const is not None:
+            del self.entries[mark:]
+            for nid in self._order[mark:]:
+                self._index.pop(nid, None)
+            del self._order[mark:]
+            self.too_small = saved
+            return None
+        self.too_small = saved
+        return gi, level
+
+    def finish(
+        self, root_gi: int, out_gis: Sequence[int] = ()
+    ) -> Optional[HashPlan]:
+        """Lay the visited entries into the per-level device layout.
+        `out_gis` selects extra entries whose digest rows the caller wants
+        read back (`HashPlan.out_rows`; the root row is appended last)."""
+        if self.too_small or not self.entries:
+            return None
+        entries = self.entries
+        n = len(entries)
+        offsets = np.zeros(n, np.int64)
+        pos = 0
+        for gi, (_lvl, template, _holes) in enumerate(entries):
+            offsets[gi] = pos
+            pos += len(template)
+        # pow2-pad the blob so repeated roots of similar tries hit a small
+        # set of compiled shapes (the slack doubles as scatter scratch)
+        blob = np.zeros(_pow2(pos + MPT_MAX_CHUNKS * RATE), np.uint8)
+        for gi, (_lvl, template, _holes) in enumerate(entries):
+            blob[offsets[gi] : offsets[gi] + len(template)] = np.frombuffer(
+                template, np.uint8
+            )
+
+        max_level = max(lvl for lvl, _t, _h in entries)
+        levels = []
+        # digest rows are laid out level by level, each level padded to a
+        # power of two — remap must use the PADDED cumulative position,
+        # since that is where the fused executor writes each level's rows
+        remap = np.zeros(n, np.int64)
+        next_global = 0
+        scratch = len(blob) - 32  # scatter target for hole padding rows
+        for lvl in range(max_level + 1):
+            idxs = [gi for gi in range(n) if entries[gi][0] == lvl]
+            for k, gi in enumerate(idxs):
+                remap[gi] = next_global + k
+            npad = _pow2(len(idxs))
+            off = np.zeros(npad, np.int32)
+            ln = np.zeros(npad, np.int32)
+            for k, gi in enumerate(idxs):
+                off[k] = offsets[gi]
+                ln[k] = len(entries[gi][1])
+            hp: List[int] = []
+            hc: List[int] = []
+            for gi in idxs:
+                for hole_off, child_gi in entries[gi][2]:
+                    hp.append(int(offsets[gi]) + hole_off)
+                    hc.append(int(remap[child_gi]))
+            hpad = _pow2(len(hp)) if hp else 1
+            hole_pos = np.full(hpad, scratch, np.int32)
+            hole_child = np.zeros(hpad, np.int32)
+            hole_pos[: len(hp)] = hp
+            hole_child[: len(hc)] = hc
+            levels.append((off, ln, hole_pos, hole_child))
+            next_global += npad
+        # the root is the unique max-level node (level(parent) >
+        # level(child) for every edge — including the value-hole edges —
+        # and all planned nodes descend from the root)
+        top_real = [gi for gi in range(n) if entries[gi][0] == max_level]
+        assert top_real == [root_gi]
+        out_rows = None
+        if out_gis:
+            out_rows = np.asarray(
+                [int(remap[g]) for g in out_gis], np.int32
+            )
+        return HashPlan(
+            blob=blob,
+            levels=levels,
+            n_nodes=n,
+            root_pos=int(remap[root_gi]),
+            out_rows=out_rows,
+        )
+
+
+def plan_payload_bytes(plan: HashPlan) -> int:
+    """Total template bytes of one plan — the shippable payload weighed by
+    the offload gate (ops/root_engine.py) and the scheduler's root-job
+    byte accounting; the pow2 blob padding is slack, not payload. ONE
+    definition so the two can never drift."""
+    return int(sum(int(ln.sum()) for _o, ln, _h, _c in plan.levels))
 
 
 def build_hash_plan(trie: Trie) -> Optional[HashPlan]:
@@ -93,112 +328,119 @@ def build_hash_plan(trie: Trie) -> Optional[HashPlan]:
     (embedded-node rule: those tries take the CPU path)."""
     if trie.root is None:
         return None
-
-    # post-order walk: child templates/levels before parents
-    entries: List[Tuple[int, bytes, List[Tuple[int, int]]]] = []  # (level, template, holes->global idx)
-    index_of: Dict[int, int] = {}
-    too_small = False
-
-    def visit(node) -> Tuple[int, int]:  # returns (global_idx, level)
-        nonlocal too_small
-        if id(node) in index_of:
-            gi = index_of[id(node)]
-            return gi, entries[gi][0]
-        if isinstance(node, LeafNode):
-            template, holes = _encode_template(
-                [encode_hex_prefix(node.path, True), node.value]
-            )
-            level = 0
-            hole_refs: List[Tuple[int, int]] = []
-        elif isinstance(node, ExtensionNode):
-            ci, clvl = visit(node.child)
-            template, holes = _encode_template(
-                [encode_hex_prefix(node.path, False), _HOLE]
-            )
-            level = clvl + 1
-            hole_refs = [(holes[0], ci)]
-        else:  # BranchNode
-            items: List = []
-            child_order: List[int] = []
-            level = 0
-            for child in node.children:
-                if child is None:
-                    items.append(b"")
-                else:
-                    ci, clvl = visit(child)
-                    items.append(_HOLE)
-                    child_order.append(ci)
-                    level = max(level, clvl)
-            items.append(node.value if node.value is not None else b"")
-            template, holes = _encode_template(items)
-            level += 1
-            hole_refs = list(zip(holes, child_order))
-        if len(template) < 32:
-            too_small = True
-        if len(template) > MPT_MAX_CHUNKS * RATE - 1:
-            too_small = True  # oversized node: CPU path (cannot happen for state tries)
-        gi = len(entries)
-        entries.append((level, template, hole_refs))
-        index_of[id(node)] = gi
-        return gi, level
-
-    root_idx, _root_level = visit(trie.root)
-    if too_small:
+    builder = PlanBuilder()
+    res = builder.try_subtree(trie.root)
+    if res is None:
         return None
+    return builder.finish(res[0])
 
-    # lay templates into one blob; group node indices by level
-    n = len(entries)
-    offsets = np.zeros(n, np.int64)
+
+def merge_plans(
+    plans: Sequence[HashPlan], blob_out: Optional[np.ndarray] = None
+) -> Tuple[HashPlan, List[np.ndarray]]:
+    """K independent HashPlans fused into ONE level-aligned device plan —
+    the cross-request coalescing behind the serving post-root path
+    (ops/root_engine.py): level l of the merged plan is the concatenation
+    of every input plan's level l, so one dispatch hashes all K requests'
+    dirty subtrees with max(depth) sequential keccak rounds instead of K
+    round trips. Row/hole indices are remapped into the merged padded row
+    space; per-plan blob regions keep their own scatter slack, so pad
+    holes stay harmless.
+
+    Returns (merged plan, per-input-plan merged out_rows — same order as
+    each plan's own out_rows, defaulting to [root]). `blob_out` hands in
+    a pre-zeroed pooled buffer at least the merged pow2 size (the serving
+    staging lease); omitted, a fresh buffer is allocated."""
+    shifts: List[int] = []
     pos = 0
-    for gi, (_lvl, template, _holes) in enumerate(entries):
-        offsets[gi] = pos
-        pos += len(template)
-    # pow2-pad the blob so repeated roots of similar tries hit a small set
-    # of compiled shapes (the slack region doubles as scatter scratch)
-    blob = np.zeros(_pow2(pos + MPT_MAX_CHUNKS * RATE), np.uint8)
-    for gi, (_lvl, template, _holes) in enumerate(entries):
-        blob[offsets[gi] : offsets[gi] + len(template)] = np.frombuffer(
-            template, np.uint8
-        )
+    for p in plans:
+        shifts.append(pos)
+        pos += len(p.blob)
+    need = _pow2(pos + MPT_MAX_CHUNKS * RATE)
+    if blob_out is not None:
+        if len(blob_out) < need:
+            raise ValueError("merge blob lease too small")
+        blob = blob_out
+    else:
+        blob = np.zeros(need, np.uint8)
+    for p, sp in zip(plans, shifts):
+        blob[sp : sp + len(p.blob)] = p.blob
 
-    max_level = max(lvl for lvl, _t, _h in entries)
-    levels = []
-    # digest rows are laid out level by level, each level padded to a power
-    # of two — remap must use the PADDED cumulative position, since that is
-    # where the fused executor actually writes each level's digests
-    remap = np.zeros(n, np.int64)
-    next_global = 0
-    scratch = len(blob) - 32  # scatter target for hole padding rows
-    for lvl in range(max_level + 1):
-        idxs = [gi for gi in range(n) if entries[gi][0] == lvl]
-        for k, gi in enumerate(idxs):
-            remap[gi] = next_global + k
-        npad = _pow2(len(idxs))
-        off = np.zeros(npad, np.int32)
-        ln = np.zeros(npad, np.int32)
-        for k, gi in enumerate(idxs):
-            off[k] = offsets[gi]
-            ln[k] = len(entries[gi][1])
-        hp: List[int] = []
-        hc: List[int] = []
-        for gi in idxs:
-            for hole_off, child_gi in entries[gi][2]:
-                hp.append(int(offsets[gi]) + hole_off)
-                hc.append(int(remap[child_gi]))
-        hpad = _pow2(len(hp)) if hp else 1
-        hole_pos = np.full(hpad, scratch, np.int32)
-        hole_child = np.zeros(hpad, np.int32)
-        hole_pos[: len(hp)] = hp
-        hole_child[: len(hc)] = hc
-        levels.append((off, ln, hole_pos, hole_child))
-        next_global += npad
-    # the root is the unique max-level node (level(parent) > level(child)
-    # for every edge, and all nodes descend from the root)
-    top_real = [gi for gi in range(n) if entries[gi][0] == max_level]
-    assert top_real == [root_idx]
-    return HashPlan(
-        blob=blob, levels=levels, n_nodes=n, root_pos=int(remap[root_idx])
+    n_levels = max(len(p.levels) for p in plans)
+    # local padded-row -> merged padded-row maps (pad rows map to 0; only
+    # pad holes reference them and those are dropped below)
+    local_maps = [
+        np.zeros(sum(len(off) for off, _l, _p, _c in p.levels), np.int64)
+        for p in plans
+    ]
+    local_starts: List[List[int]] = []
+    for p in plans:
+        starts: List[int] = []
+        s = 0
+        for off, _l, _p2, _c in p.levels:
+            starts.append(s)
+            s += len(off)
+        local_starts.append(starts)
+
+    merged_levels = []
+    merged_start = 0
+    scratch = len(blob) - 32
+    for lvl in range(n_levels):
+        offs: List[np.ndarray] = []
+        lns: List[np.ndarray] = []
+        hps: List[np.ndarray] = []
+        hcs: List[np.ndarray] = []
+        n_real_tot = 0
+        for pi, p in enumerate(plans):
+            if lvl >= len(p.levels):
+                continue
+            off, ln, hp, hc = p.levels[lvl]
+            n_real = int(np.count_nonzero(ln))
+            if n_real:
+                local_maps[pi][
+                    local_starts[pi][lvl] : local_starts[pi][lvl] + n_real
+                ] = merged_start + n_real_tot + np.arange(n_real)
+                offs.append(off[:n_real] + shifts[pi])
+                lns.append(ln[:n_real])
+            n_real_tot += n_real
+            # real holes only: pad holes point at the plan's own scratch
+            real_h = hp != (len(p.blob) - 32)
+            if real_h.any():
+                hps.append(hp[real_h] + shifts[pi])
+                # children live at strictly lower levels, already mapped
+                hcs.append(local_maps[pi][hc[real_h]])
+        npad = _pow2(max(n_real_tot, 1))
+        moff = np.zeros(npad, np.int32)
+        mln = np.zeros(npad, np.int32)
+        if offs:
+            moff[:n_real_tot] = np.concatenate(offs)
+            mln[:n_real_tot] = np.concatenate(lns)
+        nh = sum(len(h) for h in hps)
+        hpad = _pow2(nh) if nh else 1
+        mhp = np.full(hpad, scratch, np.int32)
+        mhc = np.zeros(hpad, np.int32)
+        if nh:
+            mhp[:nh] = np.concatenate(hps)
+            mhc[:nh] = np.concatenate(hcs)
+        merged_levels.append((moff, mln, mhp, mhc))
+        merged_start += npad
+
+    outs: List[np.ndarray] = []
+    for pi, p in enumerate(plans):
+        rows = (
+            p.out_rows
+            if p.out_rows is not None
+            else np.asarray([p.root_pos], np.int32)
+        )
+        outs.append(local_maps[pi][rows].astype(np.int32))
+    merged = HashPlan(
+        blob=blob,
+        levels=merged_levels,
+        n_nodes=sum(p.n_nodes for p in plans),
+        root_pos=int(local_maps[-1][plans[-1].root_pos]),
+        out_rows=np.concatenate(outs).astype(np.int32),
     )
+    return merged, outs
 
 
 # ---------------------------------------------------------------------------
@@ -206,13 +448,14 @@ def build_hash_plan(trie: Trie) -> Optional[HashPlan]:
 # ---------------------------------------------------------------------------
 
 
-def execute_plan_host(plan: HashPlan) -> bytes:
+def plan_digests_host(plan: HashPlan) -> np.ndarray:
     """CPU mirror of the fused device executor: recompute EVERY node digest
     from the plan's templates (scatter child digests into the holes, batch
     keccak each level through the native library). This is the honest CPU
     baseline for the device state-root path — identical inputs, identical
     recompute-all-hashes semantics, best available host implementation
-    (no RLP re-encoding, one keccak FFI batch per level)."""
+    (no RLP re-encoding, one keccak FFI batch per level). Returns the full
+    (total_pad, 32) u8 digest buffer in the padded row space."""
     from phant_tpu.crypto.keccak import keccak256
     from phant_tpu.utils.native import load_native
 
@@ -236,21 +479,35 @@ def execute_plan_host(plan: HashPlan) -> bytes:
             np.frombuffer(h, np.uint8) for h in hashed
         ]
         out_start += len(off)
-    return digests[plan.root_pos].tobytes()
+    return digests
 
 
-def _hash_plan_body(blob, levels, *, max_chunks: int):
+def execute_plan_host(plan: HashPlan) -> bytes:
+    """Host plan execution returning the root digest (see
+    plan_digests_host)."""
+    return plan_digests_host(plan)[plan.root_pos].tobytes()
+
+
+def execute_plan_outputs_host(plan: HashPlan) -> List[bytes]:
+    """Host plan execution returning the `out_rows` digests (root-only
+    when the plan has none) — the CPU twin of `_hash_plan_outputs`."""
+    digests = plan_digests_host(plan)
+    rows = (
+        plan.out_rows
+        if plan.out_rows is not None
+        else np.asarray([plan.root_pos], np.int64)
+    )
+    return [digests[int(r)].tobytes() for r in rows]
+
+
+def _plan_digests_body(blob, levels, *, max_chunks: int):
     """Execute a whole HashPlan in ONE device program: for each level
     (statically unrolled; shapes are the jit cache key) scatter the child
     digests into the template holes, hash the level with the batched keccak
     kernel, and append to the digest buffer. One dispatch replaces the
     per-level round trips of the old executor — on a high-latency link that
-    is the difference between ~1x and ~{levels}x RTT per root.
-
-    Returns the (8,) u32 root digest words (the root is the unique
-    max-level node, laid out last by build_hash_plan). Unjitted body so
-    `_hash_plans_batched` can vmap it over a batch of blobs; the scalar
-    entry point `_hash_plan_fused` wraps it in jit."""
+    is the difference between ~1x and ~{levels}x RTT per root. Returns the
+    full (total_pad, 8) u32 digest buffer."""
     total_pad = sum(off.shape[0] for off, _l, _p, _c in levels)
     digests = jnp.zeros((total_pad, 8), jnp.uint32)
     shifts = jnp.arange(4, dtype=jnp.uint32) * 8
@@ -266,7 +523,24 @@ def _hash_plan_body(blob, levels, *, max_chunks: int):
             digests, level_digests, (out_start, 0)
         )
         out_start += off.shape[0]
-    return digests[-1]
+    return digests
+
+
+def _hash_plan_body(blob, levels, *, max_chunks: int):
+    """(8,) u32 root digest words (the root is the unique max-level node,
+    laid out last by PlanBuilder.finish). Unjitted body so
+    `_hash_plans_batched` can vmap it over a batch of blobs; the scalar
+    entry point `_hash_plan_fused` wraps it in jit."""
+    return _plan_digests_body(blob, levels, max_chunks=max_chunks)[-1]
+
+
+@functools.partial(jax.jit, static_argnames=("max_chunks",))
+def _hash_plan_outputs(blob, levels, out_rows, *, max_chunks: int):
+    """Full-plan execution returning only the requested digest rows —
+    the serving post-root executor (ops/root_engine.py): one dispatch
+    hashes a MERGED multi-request plan and reads back each request's
+    storage roots + account root ((R, 8) u32), nothing else."""
+    return _plan_digests_body(blob, levels, max_chunks=max_chunks)[out_rows]
 
 
 _hash_plan_fused = functools.partial(jax.jit, static_argnames=("max_chunks",))(

@@ -112,6 +112,15 @@ def _default_engine_factory(index: int):
     )
 
 
+def _default_root_engine_factory(index: int):
+    """Per-device ROOT engine (ops/root_engine.py), pinned to mesh device
+    `index`: a root batch routed to this lane merges + hashes on the
+    lane's own chip — the post-root twin of the pinned witness engine."""
+    from phant_tpu.ops.root_engine import RootEngine
+
+    return RootEngine(device_index=index)
+
+
 def _abandon(engine, handle) -> None:
     """Best-effort lease release on a crash path — the scheduler's helper,
     imported lazily (scheduler.py is always loaded before a pool exists;
@@ -164,6 +173,7 @@ class MeshExecutorPool:
         prefetch: bool = True,
         engine: Optional[object] = None,
         engine_factory: Optional[Callable[[int], object]] = None,
+        root_engine_factory: Optional[Callable[[int], object]] = None,
         on_done: Callable = None,
         on_stage: Callable = None,
         on_skip: Callable = None,
@@ -196,6 +206,11 @@ class MeshExecutorPool:
             else:
                 engine_factory = _default_engine_factory
         self._engines = [engine_factory(i) for i in range(self._n)]
+        # root lane: one pinned RootEngine per device, built LAZILY on the
+        # first root batch a lane sees (construction may touch jax) and
+        # only ever from its own lane thread — no lock needed
+        self._root_factory = root_engine_factory or _default_root_engine_factory
+        self._root_engines: List[Optional[object]] = [None] * self._n
         self._on_done = on_done or (lambda *a: None)
         self._on_stage = on_stage or (lambda *a: None)
         self._on_skip = on_skip or (lambda *a: None)
@@ -405,12 +420,24 @@ class MeshExecutorPool:
                     self._on_expired(j)
         return live or None
 
+    def _root_engine_for(self, i: int):
+        """The lane's pinned RootEngine, built lazily on its first root
+        batch (only ever touched from lane thread `i`)."""
+        eng = self._root_engines[i]
+        if eng is None:
+            eng = self._root_engines[i] = self._root_factory(i)
+        return eng
+
     def _run_executor(self, i: int) -> None:
         engine = self._engines[i]
         # immutable pipeline depth, read lock-free (write-once in __init__)
         depth_cap = self._depth
         two_phase = depth_cap > 1 and hasattr(engine, "begin_batch")
-        inflight: List[tuple] = []  # [(item, handle)] begun, unresolved
+        # [(item, handle, engine)] begun, unresolved — a root batch's
+        # handle belongs to the lane's RootEngine, a witness batch's to
+        # the lane's WitnessEngine; crash paths abandon each against ITS
+        # engine
+        inflight: List[tuple] = []
         cur: Optional[dict] = None
         stage = "pack"
         try:
@@ -444,13 +471,23 @@ class MeshExecutorPool:
                         self._on_skip(item["batch_id"])
                         continue
                     item["jobs"] = jobs
+                    # lazy import like every scheduler symbol here (the
+                    # package-cycle discipline, see _abandon)
+                    from phant_tpu.serving.scheduler import _ROOT
+
+                    is_root = jobs[0].kind == _ROOT
+                    eng = self._root_engine_for(i) if is_root else engine
                     cur, stage = item, "pack"
-                    if two_phase:
-                        # the SAME witnesses list goes to prefetch and
+                    if two_phase or (is_root and depth_cap > 1):
+                        # the SAME payload list goes to prefetch and
                         # begin: plan identity is the engine's match check
-                        wits = [(j.root, j.nodes) for j in jobs]
+                        # (witness tuples / root HashPlans alike)
+                        if is_root:
+                            wits = [j.plan for j in jobs]
+                        else:
+                            wits = [(j.root, j.nodes) for j in jobs]
                         plan = None
-                        pf = getattr(engine, "prefetch_batch", None)
+                        pf = getattr(eng, "prefetch_batch", None)
                         if self._prefetch and pf is not None:
                             stage = "prefetch"
                             self._on_stage(item["batch_id"], "prefetch", i)
@@ -467,11 +504,11 @@ class MeshExecutorPool:
                         t0 = time.perf_counter()
                         try:
                             if plan is not None:
-                                handle = engine.begin_batch(
+                                handle = eng.begin_batch(
                                     wits, prefetch=plan
                                 )
                             else:
-                                handle = engine.begin_batch(wits)
+                                handle = eng.begin_batch(wits)
                         except BaseException:
                             # a lane death here reaches _die, which never
                             # sees lane-local plans: return the staging
@@ -483,7 +520,7 @@ class MeshExecutorPool:
                         item["pack_ms"] = round(
                             (time.perf_counter() - t0) * 1e3, 3
                         )
-                        inflight.append((item, handle))
+                        inflight.append((item, handle, eng))
                         stage = "dispatch"
                         self._on_stage(item["batch_id"], "dispatch", i)
                         cur = None
@@ -496,16 +533,19 @@ class MeshExecutorPool:
                     else:
                         stage = "dispatch"
                         self._on_stage(item["batch_id"], "dispatch", i)
-                        verdicts, record = self._verify_inline(engine, item)
+                        if is_root:
+                            verdicts, record = self._roots_inline(eng, item)
+                        else:
+                            verdicts, record = self._verify_inline(eng, item)
                         cur = None
                         self._finish(i, item, verdicts, record)
                         continue
                 if inflight:
-                    item2, handle = inflight.pop(0)
+                    item2, handle, eng2 = inflight.pop(0)
                     cur, stage = item2, "resolve"
                     self._on_stage(item2["batch_id"], "resolve", i)
                     t0 = time.monotonic()
-                    verdicts = engine.resolve_batch(handle)
+                    verdicts = eng2.resolve_batch(handle)
                     record = self._record_from_handle(handle, item2)
                     record["resolve_ms"] = round(
                         (time.monotonic() - t0) * 1e3, 3
@@ -514,22 +554,22 @@ class MeshExecutorPool:
                     self._finish(i, item2, verdicts, record)
         except _PoolDead as dead:
             # another lane crashed: abandon this lane's handles (the
-            # engine outlives the pool — leases must not leak) and fail
+            # engines outlive the pool — leases must not leak) and fail
             # the begun-but-unresolved jobs nobody else knows about
-            self._cleanup_inflight(engine, inflight, dead.args[0])
+            self._cleanup_inflight(inflight, dead.args[0])
             return
         except BaseException as e:  # systemic: this lane crashed
-            for it, h in inflight:
-                _abandon(engine, h)
+            for it, h, hg in inflight:
+                _abandon(hg, h)
                 if it is not cur:
                     self._fail_jobs(it["jobs"], e)
             # the crashing batch's jobs ride to scheduler._die via
             # on_crash (it fails their futures with the crash record)
             self._on_crash(e, cur["jobs"] if cur else [], stage, i)
 
-    def _cleanup_inflight(self, engine, inflight, exc) -> None:
-        for it, h in inflight:
-            _abandon(engine, h)
+    def _cleanup_inflight(self, inflight, exc) -> None:
+        for it, h, hg in inflight:
+            _abandon(hg, h)
             self._fail_jobs(it["jobs"], exc)
 
     def _fail_jobs(self, jobs, exc) -> None:
@@ -578,13 +618,35 @@ class MeshExecutorPool:
         return verdicts, record
 
     @staticmethod
-    def _record_from_handle(handle, item: dict) -> dict:
-        from phant_tpu.serving.scheduler import batch_record_from_handle
+    def _roots_inline(engine, item: dict):
+        """Depth-1 root-lane execution: one fused begin+resolve against
+        the lane's pinned RootEngine (the root_many shape)."""
+        from phant_tpu.serving.scheduler import root_record_from_handle
 
         jobs = item["jobs"]
-        record = batch_record_from_handle(
+        handle = engine.begin_batch([j.plan for j in jobs])
+        results = engine.resolve_batch(handle)
+        record = root_record_from_handle(
             handle, item["batch_id"], len(jobs), jobs[0].bucket
         )
+        record["stage"] = "dispatch"
+        return results, record
+
+    @staticmethod
+    def _record_from_handle(handle, item: dict) -> dict:
+        from phant_tpu.serving.scheduler import (
+            _ROOT,
+            batch_record_from_handle,
+            root_record_from_handle,
+        )
+
+        jobs = item["jobs"]
+        builder = (
+            root_record_from_handle
+            if jobs and jobs[0].kind == _ROOT
+            else batch_record_from_handle
+        )
+        record = builder(handle, item["batch_id"], len(jobs), jobs[0].bucket)
         if "prefetch_ms" in item:
             record["prefetch_ms"] = item["prefetch_ms"]
         return record

@@ -315,10 +315,22 @@ class SchedulerConfig:
     # per-lane engine injection (tests/bench: doubles, shared engines);
     # None = one device-pinned WitnessEngine per lane
     mesh_engine_factory: Optional[Callable] = None
+    # root-lane engine injection (tests/bench: poisoned engines, forced
+    # device floors); None = the process-shared ops/root_engine.py engine
+    # (mesh lanes build one PINNED RootEngine per device instead)
+    root_engine_factory: Optional[Callable] = None
 
 
 _WITNESS = "witness"
 _SERIAL = "serial"
+#: post-root lane (PR 11): jobs carry a fused account+storage HashPlan
+#: (stateless.WitnessStateDB.post_root_plan) and coalesce per level-shape
+#: bucket into ONE ops/root_engine.py dispatch — the same admission /
+#: fairness / assembly / pipeline / crash machinery as the witness lane
+#: (the RootEngine speaks the WitnessEngine two-phase protocol). Root
+#: buckets are NEGATIVE ints (-(level count)) so they can never collide
+#: with the witness lane's pow2-byte buckets (>= 1).
+_ROOT = "root"
 
 #: _next_batch(block=False) found nothing queued (distinct from None =
 #: closed/dead): the prefetching executor re-evaluates its pending work
@@ -416,6 +428,24 @@ def batch_record_from_handle(
     return record
 
 
+def root_record_from_handle(
+    handle, batch_id: int, batch_size: int, bucket: int
+) -> dict:
+    """The root-lane batch record: backend (device dispatch vs the
+    offload-gated host walk) and the merged payload come off the
+    RootHandle. Shared by the resolve worker and the mesh lanes, like the
+    witness record builders above."""
+    return {
+        "batch_id": batch_id,
+        "batch_size": batch_size,
+        "bucket_bytes": bucket,
+        "stage": "resolve",
+        "lane": _ROOT,
+        "backend": getattr(handle, "backend", None) or "host",
+        "payload_bytes": getattr(handle, "payload", None),
+    }
+
+
 def _abandon_handle(engine, handle) -> None:
     """Release a dispatched-but-unresolved engine handle on a crash path.
     The shared engine outlives a dead scheduler; a leaked handle would
@@ -450,6 +480,8 @@ class _Job:
     nodes: Sequence[bytes] = ()
     nbytes: int = 0
     bucket: int = 0
+    # root lane: the request's fused post-root HashPlan
+    plan: Optional[object] = None
     # serial lane
     fn: Optional[Callable] = None
     # observability: the submitting request's trace context, and the batch
@@ -495,6 +527,9 @@ class VerificationScheduler:
             else None
         )
         self._engine = engine
+        # root-lane engine, resolved lazily on the first root batch (the
+        # shared ops/root_engine.py engine unless the config injects one)
+        self._root_engine = None
         # mesh dispatch: per-device executors behind the assembler. The
         # pool is built here (its engines are jax-free until the device
         # route engages) and the scheduler's own resolve worker is NOT —
@@ -513,6 +548,13 @@ class VerificationScheduler:
                 prefetch=self.config.prefetch,
                 engine=engine,
                 engine_factory=self.config.mesh_engine_factory,
+                # root lane: an injected factory is index-blind (doubles);
+                # the default builds one PINNED RootEngine per lane
+                root_engine_factory=(
+                    (lambda _i: self.config.root_engine_factory())
+                    if self.config.root_engine_factory is not None
+                    else None
+                ),
                 on_done=self._mesh_done,
                 on_stage=self._mesh_stage,
                 on_skip=self._mesh_skip,
@@ -590,6 +632,11 @@ class VerificationScheduler:
             # how often the adaptive policy changed the assembly wait
             "evicted": 0,
             "wait_adjustments": 0,
+            # post-root lane (PR 11): batches through ops/root_engine.py
+            # and requests that shared a coalesced root dispatch
+            "root_batches": 0,
+            "root_requests": 0,
+            "root_coalesced": 0,
         }
         metrics.gauge_set("sched.pipeline_depth", self._pipe_depth)
         self._thread = threading.Thread(
@@ -708,6 +755,122 @@ class VerificationScheduler:
         )
         self._admit(job, False)
         return job.future
+
+    # -- root lane (batched post-state roots, PR 11) -------------------------
+
+    def _root_job(
+        self,
+        plan,
+        deadline_s: Optional[float],
+        tenant: Optional[str],
+        priority: Optional[int],
+    ) -> _Job:
+        # level-shape bucket: plans with the same depth coalesce into one
+        # merged dispatch (pow2 padding absorbs the per-level widths);
+        # NEGATIVE so it never collides with the witness pow2 buckets
+        from phant_tpu.ops.mpt_jax import plan_payload_bytes
+
+        return _Job(
+            kind=_ROOT,
+            future=Future(),
+            admitted=time.monotonic(),
+            deadline=self._deadline(deadline_s),
+            tenant=tenant if tenant is not None else current_tenant(),
+            priority=priority if priority is not None else current_priority(),
+            plan=plan,
+            nbytes=plan_payload_bytes(plan),
+            bucket=-len(plan.levels),
+            trace_id=current_trace_id(),
+        )
+
+    def submit_root(
+        self,
+        plan,
+        deadline_s: Optional[float] = None,
+        wait_for_space: bool = False,
+        tenant: Optional[str] = None,
+        priority: Optional[int] = None,
+    ) -> Future:
+        """Queue one fused post-root HashPlan (ops/mpt_jax, built by
+        stateless.WitnessStateDB.post_root_plan); the future resolves to
+        the plan's out-row digests (storage roots in patch order, the
+        post root LAST). Admission, per-tenant QoS, deadlines, and
+        overload shedding are the witness lane's — same codes, same shed
+        order."""
+        job = self._root_job(plan, deadline_s, tenant, priority)
+        self._admit(job, wait_for_space)
+        return job.future
+
+    def root_traced(
+        self,
+        plan,
+        deadline_s: Optional[float] = None,
+        tenant: Optional[str] = None,
+        priority: Optional[int] = None,
+    ) -> Tuple[List[bytes], Optional[dict]]:
+        """One post root through the batching path, returning (out
+        digests, batch record) — the root twin of verify_traced; the
+        record joins the caller's `verify_block` span to the coalesced
+        root dispatch that served it."""
+        job = self._root_job(plan, deadline_s, tenant, priority)
+        self._admit(job, False)
+        return job.future.result(), job.meta
+
+    def root_many(self, plans: Sequence) -> List[List[bytes]]:
+        """Out digests for a span of plans, pushed through the SAME
+        admission/assembly/executor path the server uses — the offline
+        face of the root lane (bench, tests). Blocks on queue space and
+        applies no deadline, like verify_many."""
+        if threading.current_thread() in (
+            self._thread,
+            self._resolve_thread,
+            self._prefetch_thread,
+        ):
+            raise RuntimeError(
+                "root_many called from a scheduler thread (deadlock)"
+            )
+        futs = [
+            self.submit_root(p, deadline_s=float("inf"), wait_for_space=True)
+            for p in plans
+        ]
+        return [f.result() for f in futs]
+
+    def accepts_root(self) -> bool:
+        """Can the CURRENT thread route a post root through this
+        scheduler? The root lane shares the witness lane's consumers and
+        lifecycle, so the answer is the same."""
+        return self.accepts_witness()
+
+    def root_backlog(self) -> int:
+        """Root jobs currently queued — the lone-request guard's company
+        signal (stateless.compute_post_root): with nobody to coalesce
+        with, a sub-break-even request skips plan construction entirely
+        and keeps the host walk."""
+        with self._lock:
+            return sum(
+                1
+                for lane in self._lanes.values()
+                for j in lane
+                if j.kind == _ROOT
+            )
+
+    def _resolve_root_engine(self):
+        if self._root_engine is None:
+            if self.config.root_engine_factory is not None:
+                self._root_engine = self.config.root_engine_factory()
+            else:
+                from phant_tpu.ops.root_engine import shared_root_engine
+
+                self._root_engine = shared_root_engine()
+        return self._root_engine
+
+    @staticmethod
+    def _payload_of(jobs: List[_Job], kind: str) -> list:
+        """The engine-facing batch payload: (root, nodes) tuples for the
+        witness lane, HashPlans for the root lane."""
+        if kind == _ROOT:
+            return [j.plan for j in jobs]
+        return [(j.root, j.nodes) for j in jobs]
 
     def _deadline(self, deadline_s: Optional[float]) -> Optional[float]:
         if deadline_s is None:
@@ -1174,11 +1337,14 @@ class VerificationScheduler:
         else:
             stall_deadline = None
         trace_ids = [j.trace_id for j in batch]
+        kind = batch[0].kind
         item = {
             "jobs": batch,
+            "kind": kind,
             # the SAME list object goes to prefetch_batch and begin_batch:
             # plan identity is how the engine knows the plan matches
-            "witnesses": [(j.root, j.nodes) for j in batch],
+            # (witness tuples or root HashPlans alike)
+            "payload": self._payload_of(batch, kind),
             "picked": now,
             "plan": None,
             "ready": False,
@@ -1269,6 +1435,28 @@ class VerificationScheduler:
             raise RuntimeError(
                 "chaos drill: PHANT_SCHED_CHAOS_CRASH=1 induced executor crash"
             )
+        kind = item.get("kind", _WITNESS)
+        if kind == _ROOT:
+            # root batches always have a two-phase engine; a fully-shed
+            # batch just releases the prefetch merge
+            if not jobs:
+                if plan is not None:
+                    plan.release()
+                with self._lock:
+                    self._drop_inflight_locked(batch_id)
+                return
+            self._pipeline_handoff(
+                jobs,
+                batch_id,
+                self._resolve_root_engine(),
+                item["picked"],
+                plan=plan,
+                prefetch_ms=item.get("prefetch_ms"),
+                plan_payload=item["payload"],
+                plan_njobs=len(item["jobs"]),
+                kind=_ROOT,
+            )
+            return
         engine = self._resolve_engine()
         if not jobs or not (
             self._pipe_depth > 1 and hasattr(engine, "begin_batch")
@@ -1296,7 +1484,7 @@ class VerificationScheduler:
             item["picked"],
             plan=plan,
             prefetch_ms=item.get("prefetch_ms"),
-            plan_witnesses=item["witnesses"],
+            plan_payload=item["payload"],
             plan_njobs=len(item["jobs"]),
         )
 
@@ -1308,8 +1496,9 @@ class VerificationScheduler:
         picked: float,
         plan=None,
         prefetch_ms: Optional[float] = None,
-        plan_witnesses=None,
+        plan_payload=None,
         plan_njobs: int = 0,
+        kind: str = _WITNESS,
     ) -> None:
         """Shared tail of the pipelined witness paths (3- and 4-stage):
         wait for a pipeline slot, re-shed expired jobs, begin_batch —
@@ -1343,23 +1532,25 @@ class VerificationScheduler:
             with self._lock:
                 self._drop_inflight_locked(batch_id)
             return
-        if plan_witnesses is not None and len(jobs) == plan_njobs:
+        if plan_payload is not None and len(jobs) == plan_njobs:
             # the SAME list object the plan was computed over — identity
             # is how begin_batch knows the plan matches; any shed along
             # the way invalidates it and begin_batch drops it, correctly
-            witnesses = plan_witnesses
+            payload = plan_payload
         else:
-            witnesses = [(j.root, j.nodes) for j in jobs]
+            payload = self._payload_of(jobs, kind)
         t_pack = time.perf_counter()
         if plan is not None:
-            handle = engine.begin_batch(witnesses, prefetch=plan)
+            handle = engine.begin_batch(payload, prefetch=plan)
         else:
-            handle = engine.begin_batch(witnesses)
+            handle = engine.begin_batch(payload)
         pipe_item = {
             "jobs": jobs,
             "handle": handle,
             "batch_id": batch_id,
             "picked": picked,
+            "kind": kind,
+            "engine": engine,
             "pack_ms": round((time.perf_counter() - t_pack) * 1e3, 3),
         }
         if prefetch_ms is not None:
@@ -1407,12 +1598,18 @@ class VerificationScheduler:
                         "chaos drill: PHANT_SCHED_CHAOS_CRASH=prefetch "
                         "induced prefetch-stage crash"
                     )
-                engine = self._resolve_engine()
+                if item.get("kind") == _ROOT:
+                    # root lane: the 4th stage runs the PLAN LOWERING —
+                    # merging the batch's HashPlans into the pooled
+                    # staging blob (ops/root_engine.py prefetch_batch)
+                    engine = self._resolve_root_engine()
+                else:
+                    engine = self._resolve_engine()
                 pf = getattr(engine, "prefetch_batch", None)
                 plan = None
                 if pf is not None:
                     t0 = time.perf_counter()
-                    plan = pf(item["witnesses"])
+                    plan = pf(item["payload"])
                     pf_ms = round((time.perf_counter() - t0) * 1e3, 3)
                 with self._lock:
                     orphaned = self._dead is not None
@@ -1664,7 +1861,11 @@ class VerificationScheduler:
             self._exec_stage = stage
         else:
             self._exec_stage = "pack"  # provisional: engine resolution
-            engine = self._resolve_engine()
+            engine = (
+                self._resolve_root_engine()
+                if lane == _ROOT
+                else self._resolve_engine()
+            )
             pipelined = self._pipe_depth > 1 and hasattr(engine, "begin_batch")
             # stage vocabulary: pipelined batches move pack -> dispatch ->
             # resolve; a depth-1/inline batch runs all three fused under
@@ -1700,16 +1901,21 @@ class VerificationScheduler:
         if pipelined:
             # the descriptor stays in flight until the resolve worker
             # finishes the batch (or _die clears everything)
-            self._execute_witness_pipelined(batch, batch_id, engine, now)
+            self._execute_witness_pipelined(batch, batch_id, engine, now, kind=lane)
             return
-        if lane == _WITNESS and self._pool is not None:
+        if lane in (_WITNESS, _ROOT) and self._pool is not None:
             # the descriptor stays in flight until the mesh lane finishes
             # the batch (_mesh_done/_mesh_skip) or _die clears everything
-            self._execute_witness_mesh(batch, batch_id, now)
+            if lane == _ROOT:
+                self._execute_roots_mesh(batch, batch_id, now)
+            else:
+                self._execute_witness_mesh(batch, batch_id, now)
             return
         try:
             if lane == _SERIAL:
                 self._execute_serial(batch[0], batch_id)
+            elif lane == _ROOT:
+                self._execute_roots(batch, batch_id, engine, now)
             else:
                 self._execute_witness(batch, batch_id, engine, now)
         finally:
@@ -1807,13 +2013,19 @@ class VerificationScheduler:
         self._finish_witness_jobs(jobs, verdicts, record, picked)
 
     def _execute_witness_pipelined(
-        self, batch: List[_Job], batch_id: int, engine, picked: float
+        self,
+        batch: List[_Job],
+        batch_id: int,
+        engine,
+        picked: float,
+        kind: str = _WITNESS,
     ) -> None:
         """Pack + dispatch on the executor thread, resolve on the resolve
         worker: begin_batch holds the engine lock only for the intern
-        scan and enqueues the device keccak with NO host sync, so this
-        thread moves straight on to assembling (and packing) the next
-        batch while the device computes and the worker resolves."""
+        scan (witness lane) or runs the plan merge (root lane) and
+        enqueues the device work with NO host sync, so this thread moves
+        straight on to assembling (and packing) the next batch while the
+        device computes and the worker resolves."""
         jobs = self._shed_or_keep(batch, picked)
         if not jobs:
             with self._lock:
@@ -1823,7 +2035,74 @@ class VerificationScheduler:
             raise RuntimeError(
                 "chaos drill: PHANT_SCHED_CHAOS_CRASH=1 induced executor crash"
             )
-        self._pipeline_handoff(jobs, batch_id, engine, picked)
+        self._pipeline_handoff(jobs, batch_id, engine, picked, kind=kind)
+
+    def _execute_roots(
+        self, batch: List[_Job], batch_id: int, engine, picked: float
+    ) -> None:
+        """Depth-1/inline root execution: one begin+resolve round trip on
+        the executor thread (the root_many shape) — the coalesced batch
+        still merges into ONE dispatch; only the pipeline overlap is
+        absent."""
+        jobs = self._shed_or_keep(batch, picked)
+        if not jobs:
+            return
+        self._exec_stage = "dispatch"
+        handle = engine.begin_batch([j.plan for j in jobs])
+        results = engine.resolve_batch(handle)
+        record = root_record_from_handle(
+            handle, batch_id, len(jobs), jobs[0].bucket
+        )
+        record["stage"] = "dispatch"  # fused begin+resolve, like depth-1
+        self._finish_root_jobs(jobs, results, record, picked)
+
+    def _finish_root_jobs(
+        self, jobs: List[_Job], results, record: dict, picked: float
+    ) -> None:
+        """Root-lane completion tail: per-job meta + future resolution
+        (each future gets ITS plan's out digests), the batch_done record,
+        and the coalescing metrics/stats."""
+        n = len(jobs)
+        done = time.monotonic()
+        served: dict = {}
+        for j, digests in zip(jobs, results):
+            served[j.tenant] = served.get(j.tenant, 0) + 1
+            # meta BEFORE set_result (the verify_traced/root_traced
+            # ordering contract)
+            j.meta = {
+                **record,
+                "tenant": j.tenant,
+                "queue_wait_ms": round((picked - j.admitted) * 1e3, 3),
+            }
+            _safe_resolve(j.future, digests)
+        flight.record(
+            "sched.batch_done",
+            duration_ms=round((done - picked) * 1e3, 3),
+            n_ok=n,
+            tenants=sorted(served),
+            trace_ids=[j.trace_id for j in jobs],
+            **record,
+        )
+        metrics.observe_hist("sched.batch_size", n, buckets=_BATCH_BUCKETS)
+        metrics.count("sched.batches", lane="root")
+        metrics.count("sched.root_batches", backend=record.get("backend", "host"))
+        if n > 1:
+            metrics.count("sched.root_coalesced", n)
+        for tenant, cnt in served.items():
+            metrics.count("sched.tenant_served", cnt, tenant=tenant)
+        with self._lock:
+            st = self.stats
+            st["batches"] += 1
+            st["batched_requests"] += n
+            st["root_batches"] += 1
+            st["root_requests"] += n
+            if n > 1:
+                st["root_coalesced"] += n
+                st["coalesced"] += n
+            if n > st["max_batch_seen"]:
+                st["max_batch_seen"] = n
+            for tenant, cnt in served.items():
+                self._tenant_locked(tenant)["served"] += cnt
 
     # -- mesh dispatch (mesh_devices >= 1, serving/mesh_exec.py) -------------
 
@@ -1896,10 +2175,37 @@ class VerificationScheduler:
                 if d["batch_id"] == batch_id:
                     d["device"] = device
 
+    def _execute_roots_mesh(
+        self, batch: List[_Job], batch_id: int, picked: float
+    ) -> None:
+        """Fan one root batch out to the per-device pool: bucket-affinity
+        routing (a level shape keeps hitting the same lane's pinned
+        RootEngine, so its compiled program stays warm on that chip) with
+        the same spillover/backpressure as witness batches. Root batches
+        never take the megabatch path — there is no whole-mesh fused
+        root kernel; the lane's merged dispatch IS the fusion."""
+        jobs = self._shed_or_keep(batch, picked)
+        if not jobs:
+            with self._lock:
+                self._drop_inflight_locked(batch_id)
+            return
+        device = self._pool.submit(jobs, batch_id, picked)
+        if device is None:
+            raise SchedulerDown("mesh executor pool is down")
+        with self._lock:
+            self.stats["mesh_batches"] += 1
+            for d in self._inflight_list:
+                if d["batch_id"] == batch_id:
+                    d["device"] = device
+
     def _mesh_done(self, jobs, verdicts, record, picked, batch_id) -> None:
-        """Lane completion (pool thread): the shared completion tail, then
-        the watchdog descriptor drops."""
-        self._finish_witness_jobs(jobs, verdicts, record, picked)
+        """Lane completion (pool thread): the shared completion tail —
+        witness or root by the jobs' kind — then the watchdog descriptor
+        drops."""
+        if jobs and jobs[0].kind == _ROOT:
+            self._finish_root_jobs(jobs, verdicts, record, picked)
+        else:
+            self._finish_witness_jobs(jobs, verdicts, record, picked)
         with self._lock:
             self._drop_inflight_locked(batch_id)
             self._cond.notify_all()
@@ -2014,22 +2320,33 @@ class VerificationScheduler:
             # resolve_batch releases its own handle on failure; a crash
             # elsewhere in the loop still must not leak it
             if item is not None:
-                _abandon_handle(self._engine, item["handle"])
+                _abandon_handle(
+                    item.get("engine") or self._engine, item["handle"]
+                )
             self._die(e, item["jobs"] if item else [], stage="resolve")
 
     def _resolve_one(self, item: dict) -> None:
         jobs = item["jobs"]
         handle = item["handle"]
+        engine = item.get("engine") or self._engine
         t0 = time.monotonic()
-        verdicts = self._engine.resolve_batch(handle)
-        record = batch_record_from_handle(
-            handle, item["batch_id"], len(jobs), jobs[0].bucket
-        )
+        if item.get("kind") == _ROOT:
+            results = engine.resolve_batch(handle)
+            record = root_record_from_handle(
+                handle, item["batch_id"], len(jobs), jobs[0].bucket
+            )
+            finish = self._finish_root_jobs
+        else:
+            results = engine.resolve_batch(handle)
+            record = batch_record_from_handle(
+                handle, item["batch_id"], len(jobs), jobs[0].bucket
+            )
+            finish = self._finish_witness_jobs
         record["pack_ms"] = item["pack_ms"]
         if "prefetch_ms" in item:
             record["prefetch_ms"] = item["prefetch_ms"]
         record["resolve_ms"] = round((time.monotonic() - t0) * 1e3, 3)
-        self._finish_witness_jobs(jobs, verdicts, record, item["picked"])
+        finish(jobs, results, record, item["picked"])
 
     def _resolve_engine(self):
         if self._engine is None:
@@ -2081,11 +2398,11 @@ class VerificationScheduler:
             self._inflight_list = []
             batch_id = self._batch_seq
             self._cond.notify_all()
-        engine = self._engine
         for item in dropped_items:
             # never resolved, never will be: release the engine leases so
             # a shared engine keeps evicting after this scheduler's death
-            _abandon_handle(engine, item["handle"])
+            # (each pipe item carries ITS engine — witness or root)
+            _abandon_handle(item.get("engine") or self._engine, item["handle"])
         for item in dropped_plans:
             plan = item.get("plan")
             if plan is not None:

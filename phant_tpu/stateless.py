@@ -33,7 +33,7 @@ formats must include deletion siblings, as real stateless protocols do.
 from __future__ import annotations
 
 from dataclasses import dataclass
-from typing import Dict, List, Optional, Tuple
+from typing import Dict, List, Optional, Sequence, Tuple
 
 from phant_tpu import rlp
 from phant_tpu.crypto.keccak import keccak256
@@ -103,8 +103,13 @@ def _resolve(digest: bytes, db: Dict[bytes, bytes]):
 class PartialTrie(Trie):
     """A trie over witness nodes; unwitnessed subtrees are HashNodes.
 
-    root_hash() stays on the host: a witness subtree is a few hundred nodes,
-    below the device-dispatch break-even (see trie_root_hash threshold)."""
+    Hashing: `root_hash()` is the host walk. Whether a partial trie's
+    post-root re-hash runs here or as part of a batched device plan is
+    decided by THE offload-gate story in ops/root_engine.py (single
+    source of truth) — one witness subtree alone is below the
+    device-dispatch break-even, but the serving path coalesces many
+    requests' plans into one dispatch, which is where the device wins
+    (WitnessStateDB.post_root_plan / compute_post_root)."""
 
     def __init__(self, root_digest: bytes, db: Dict[bytes, bytes]):
         super().__init__()
@@ -272,11 +277,56 @@ def witness_node_db(nodes: List[bytes]) -> Dict[bytes, bytes]:
     return dict(zip(keccak256_batch_cpu(nodes), nodes))
 
 
+#: `_applied_accounts` sentinels: the account's leaf was deleted from the
+#: trie / the address was never written under the current generation
+_DELETED = object()
+_UNSET = object()
+
+
+class _RootPatch:
+    """One account leaf awaiting its plan-computed storage root: the leaf
+    was put with a zeroed 32-byte placeholder, and `apply_post_root`
+    patches the real digest in once the plan resolves."""
+
+    __slots__ = ("addr", "leaf", "prefix", "suffix", "gi", "fields")
+
+    def __init__(self, addr, leaf, prefix, suffix, gi, fields):
+        self.addr = addr
+        self.leaf = leaf  # the LeafNode object inside the account trie
+        self.prefix = prefix  # account-RLP bytes before the storage root
+        self.suffix = suffix  # account-RLP bytes after it
+        self.gi = gi  # the storage root's entry in the plan builder
+        self.fields = fields  # (nonce, balance, code_hash)
+
+
+class PostRootPlan:
+    """A request's fused account+storage hash plan plus the host-side
+    patch list (`WitnessStateDB.post_root_plan` -> serving root lane ->
+    `apply_post_root`). `plan.out_rows` reads back one storage root per
+    patch (same order) and the account root LAST."""
+
+    __slots__ = ("plan", "patches")
+
+    def __init__(self, plan, patches):
+        self.plan = plan  # ops/mpt_jax.HashPlan
+        self.patches = patches  # List[_RootPatch]
+
+    @property
+    def levels(self) -> int:
+        return len(self.plan.levels)
+
+
 class WitnessStateDB(StateDB):
     """StateDB over a witness: accounts and storage slots materialize on
     first access by walking the partial state trie; `state_root()` writes
     every dirty account back into the partial trie and recomputes the root.
     Touching anything outside the witness raises StatelessError.
+
+    Write-backs are MEMOIZED (`_applied_*`): what was already written
+    into the partial tries is remembered, so a repeated `state_root()`
+    call with nothing changed in between re-applies nothing and hashes
+    zero nodes (the post-root memo) — the pre-r11 behavior rebuilt
+    `changed` from scratch and re-put every changed slot per call.
 
     `node_db` hands in the witness's digest -> node map decoded earlier
     on the request path (witness_node_db) so each witness is decoded
@@ -306,6 +356,16 @@ class WitnessStateDB(StateDB):
         # original object, so identity is a reliable generation marker) —
         # a recreated account starts from an EMPTY storage trie
         self._mat_objs: Dict[bytes, object] = {}
+        # post-root write-back memoization (PR 11): what has ALREADY been
+        # applied to the partial tries, so repeated state_root() calls
+        # are idempotent-cheap and the batched plan path shares one
+        # dirtiness scan with the host walk
+        self._applied_slots: Dict[Tuple[bytes, int], int] = {}
+        self._applied_accounts: Dict[bytes, object] = {}  # tuple | _DELETED
+        self._applied_gen: Dict[bytes, object] = {}  # acct identity applied
+        self._storage_root_memo: Dict[bytes, bytes] = {}
+        self._sroot_dirty: set = set()  # applied writes, root not yet known
+        self._post_root_memo: Optional[bytes] = None
 
     # --- materialization ---------------------------------------------------
 
@@ -413,69 +473,401 @@ class WitnessStateDB(StateDB):
     # --- post root ----------------------------------------------------------
 
     def state_root(self) -> bytes:
-        """Post-state root over the witnessed subtree: write every account
+        """Post-state root over the witnessed subtree — the HOST walk, and
+        the oracle the batched device path (post_root_plan / ops/
+        root_engine.py) is differential-tested against: write every account
         this execution changed back into the partial trie (untouched
         subtrees contribute their witnessed digests; unchanged materialized
         accounts are skipped — dirtiness check), recomputing storage roots
         for accounts whose slots changed. Deleted accounts (EIP-158 cleanup,
-        selfdestruct) are removed with full node collapse."""
+        selfdestruct) are removed with full node collapse. Idempotent-cheap:
+        a repeated call with nothing changed applies nothing and returns
+        the memoized root without hashing a single node."""
+        changed_any = False
         for addr in sorted(self._seen | set(self.accounts)):
             acct = self.accounts.get(addr)
             key = keccak256(addr)
             if acct is None:
-                if addr in self._pre_accounts:  # existed pre-state: delete
-                    self._trie.delete(key)
+                if self._delete_account_leaf(addr, key):
+                    changed_any = True
                 continue
             sroot = self._storage_root_of(addr, acct)
-            pre = self._pre_accounts.get(addr)
-            if (
-                pre is not None
-                and self._mat_objs.get(addr) is acct
-                and pre == (acct.nonce, acct.balance, acct.code_hash())
-                and sroot == self._storage_roots.get(addr, EMPTY_TRIE_ROOT)
-            ):
-                continue  # account unchanged: leave its witnessed leaf alone
-            leaf = rlp.encode(
-                [
-                    rlp.encode_uint(acct.nonce),
-                    rlp.encode_uint(acct.balance),
-                    sroot,
-                    acct.code_hash(),
-                ]
-            )
-            self._trie.put(key, leaf)
-        return self._trie.root_hash()
+            target = (acct.nonce, acct.balance, sroot, acct.code_hash())
+            if target == self._account_baseline(addr, acct):
+                continue  # account unchanged: leave its leaf alone
+            self._post_root_memo = None
+            self._trie.put(key, self._account_leaf_value(*target))
+            self._applied_accounts[addr] = target
+            changed_any = True
+        if not changed_any and self._post_root_memo is not None:
+            return self._post_root_memo
+        root = self._trie.root_hash()
+        self._post_root_memo = root
+        return root
 
-    def _storage_root_of(self, addr: bytes, acct: Account) -> bytes:
+    @staticmethod
+    def _account_leaf_value(
+        nonce: int, balance: int, sroot: bytes, code_hash: bytes
+    ) -> bytes:
+        return rlp.encode(
+            [rlp.encode_uint(nonce), rlp.encode_uint(balance), sroot, code_hash]
+        )
+
+    def _delete_account_leaf(self, addr: bytes, key: bytes) -> bool:
+        """Delete the account's leaf if the trie currently holds one
+        (pre-existed, or put by an earlier state_root call); idempotent."""
+        applied = self._applied_accounts.get(addr, _UNSET)
+        if applied is _DELETED:
+            return False
+        if applied is _UNSET and addr not in self._pre_accounts:
+            return False
+        self._post_root_memo = None  # trie mutates: memo invalid NOW (an
+        # abort path between here and the recompute must not resurrect it)
+        self._trie.delete(key)
+        self._applied_accounts[addr] = _DELETED
+        return True
+
+    def _account_baseline(self, addr: bytes, acct: Account):
+        """What the account trie currently holds for `addr`: the last
+        applied leaf fields, or the witnessed pre-state when nothing was
+        applied and the materialized identity is unchanged. _UNSET (never
+        equal to a target tuple) when the address has no leaf under the
+        current account generation — a create/recreate must put."""
+        applied = self._applied_accounts.get(addr, _UNSET)
+        if applied is not _UNSET:
+            return applied
+        pre = self._pre_accounts.get(addr)
+        if pre is not None and self._mat_objs.get(addr) is acct:
+            return (
+                pre[0],
+                pre[1],
+                self._storage_roots.get(addr, EMPTY_TRIE_ROOT),
+                pre[2],
+            )
+        return _UNSET
+
+    def _storage_changes(
+        self, addr: bytes, acct: Account
+    ) -> Tuple[bytes, Dict[int, int], bool]:
+        """(pre_root, {slot: value} still to apply, fresh): the pending
+        storage-trie writes for one account, diffed against what earlier
+        state_root/post_root_plan calls already applied."""
         fresh = self._mat_objs.get(addr) is not acct  # created (or recreated
         # after selfdestruct) this block: storage starts from the empty trie
+        if fresh and self._applied_gen.get(addr) is not acct:
+            # a recreated account invalidates writes applied under the
+            # dead generation — its storage trie restarts from EMPTY
+            for k in [k for k in self._applied_slots if k[0] == addr]:
+                del self._applied_slots[k]
+            self._storage_ptries.pop(addr, None)
+            self._storage_root_memo.pop(addr, None)
+            self._sroot_dirty.discard(addr)
+            self._applied_gen[addr] = acct
         pre_root = (
-            EMPTY_TRIE_ROOT if fresh else self._storage_roots.get(addr, EMPTY_TRIE_ROOT)
+            EMPTY_TRIE_ROOT
+            if fresh
+            else self._storage_roots.get(addr, EMPTY_TRIE_ROOT)
         )
         dirty = set(self._slots_seen.get(addr, ()))
         dirty |= set(acct.storage)
-        changed = {
-            s for s in dirty
-            if acct.storage.get(s, 0)
-            != (0 if fresh else self._pre_slots.get((addr, s), 0))
-        }
-        if not changed:
-            return pre_root
-        strie = self._storage_ptries.get(addr) if not fresh else None
+        changed: Dict[int, int] = {}
+        for s in dirty:
+            cur = acct.storage.get(s, 0)
+            k = (addr, s)
+            if k in self._applied_slots:
+                base = self._applied_slots[k]
+            elif fresh:
+                base = 0
+            else:
+                base = self._pre_slots.get(k, 0)
+            if cur != base:
+                changed[s] = cur
+        return pre_root, changed, fresh
+
+    def _apply_storage(
+        self, addr: bytes, acct: Account, pre_root: bytes, changed: Dict[int, int]
+    ) -> PartialTrie:
+        """Write one account's pending slot changes into its storage trie
+        (host structural work — identical on the host-walk and plan
+        paths); the root itself is computed by the caller's path."""
+        strie = self._storage_ptries.get(addr)
         if strie is None:
             strie = PartialTrie(pre_root, self._db)
             self._storage_ptries[addr] = strie
+        self._post_root_memo = None  # the account leaf WILL change; an
+        # abort before the recompute must not leave the old memo live
         for slot in sorted(changed):
-            value = acct.storage.get(slot, 0)
+            value = changed[slot]
             key = keccak256(slot.to_bytes(32, "big"))
             if value == 0:
                 strie.delete(key)  # storage-zeroing: delete with collapse
             else:
                 strie.put(key, rlp.encode(rlp.encode_uint(value)))
-        return strie.root_hash()
+            self._applied_slots[(addr, slot)] = value
+        self._applied_gen[addr] = acct
+        self._storage_root_memo.pop(addr, None)
+        self._sroot_dirty.add(addr)
+        return strie
+
+    def _storage_root_of(self, addr: bytes, acct: Account) -> bytes:
+        pre_root, changed, _fresh = self._storage_changes(addr, acct)
+        if changed:
+            self._apply_storage(addr, acct, pre_root, changed)
+        if addr in self._sroot_dirty:
+            root = self._storage_ptries[addr].root_hash()
+            self._storage_root_memo[addr] = root
+            self._sroot_dirty.discard(addr)
+            return root
+        return self._storage_root_memo.get(addr, pre_root)
+
+    # --- batched post root (the serving device path) -------------------------
+
+    def post_root_plan(self) -> Optional[PostRootPlan]:
+        """Fused account+storage HashPlan for the BATCHED post-root path
+        (ops/root_engine.py): trie mutations are applied on the host
+        exactly like state_root() (structure is host work either way),
+        but every keccak is left to the plan — HashNode digests enter
+        parent templates as constants, dirty nodes become per-level RLP
+        templates with 32-byte child holes, and each dirty storage
+        trie's root is a hole INSIDE its account leaf, so ONE plan per
+        request re-derives every digest up to the post root.
+
+        Returns None when the host walk should run instead: nothing is
+        dirty (the memo answers), or the ACCOUNT trie contains embedded
+        (<32 B) nodes. A storage trie with embedded nodes falls back
+        ALONE — its root is hashed on the host and baked into the leaf
+        as a constant, the same per-trie fallback trie_root_device
+        applies. Either way the tries are left consistent: a follow-up
+        state_root() is always correct (and cheap, via the memos)."""
+        from phant_tpu.ops.mpt_jax import PlanBuilder
+
+        builder = PlanBuilder()
+        patches: List[_RootPatch] = []
+        changed_any = False
+        for addr in sorted(self._seen | set(self.accounts)):
+            acct = self.accounts.get(addr)
+            key = keccak256(addr)
+            if acct is None:
+                if self._delete_account_leaf(addr, key):
+                    changed_any = True
+                continue
+            pre_root, changed, _fresh = self._storage_changes(addr, acct)
+            hole = None  # (gi, level) of a plan-computed storage root
+            if changed:
+                strie = self._apply_storage(addr, acct, pre_root, changed)
+                sroot: Optional[bytes] = None
+                if strie.root is None:
+                    sroot = EMPTY_TRIE_ROOT
+                else:
+                    hole = builder.try_subtree(strie.root)
+                    if hole is None:
+                        # embedded-node storage trie: host fallback for
+                        # THIS trie only (constant root in the leaf)
+                        sroot = strie.root_hash()
+                if sroot is not None:
+                    self._storage_root_memo[addr] = sroot
+                    self._sroot_dirty.discard(addr)
+            elif addr in self._sroot_dirty:
+                sroot = self._storage_ptries[addr].root_hash()
+                self._storage_root_memo[addr] = sroot
+                self._sroot_dirty.discard(addr)
+            else:
+                sroot = self._storage_root_memo.get(addr, pre_root)
+            fields = (acct.nonce, acct.balance, acct.code_hash())
+            if hole is None:
+                target = (acct.nonce, acct.balance, sroot, acct.code_hash())
+                if target == self._account_baseline(addr, acct):
+                    continue
+                self._post_root_memo = None
+                self._trie.put(key, self._account_leaf_value(*target))
+                self._applied_accounts[addr] = target
+            else:
+                prefix, suffix = self._account_leaf_segments(fields)
+                self._post_root_memo = None
+                self._trie.put(key, prefix + b"\x00" * 32 + suffix)
+                leaf = _find_leaf(self._trie, key)
+                if leaf is None:  # cannot happen for 32-byte keccak keys
+                    self._repair_pending(patches)
+                    return None
+                builder.value_holes[id(leaf)] = (
+                    prefix,
+                    suffix,
+                    hole[0],
+                    hole[1],
+                )
+                patches.append(
+                    _RootPatch(addr, leaf, prefix, suffix, hole[0], fields)
+                )
+            changed_any = True
+        if not changed_any:
+            return None  # state_root() answers from the memo / pre root
+        root = self._trie.root
+        res = builder.try_subtree(root) if root is not None else None
+        if res is None:
+            self._repair_pending(patches)
+            return None
+        plan = builder.finish(res[0], [p.gi for p in patches] + [res[0]])
+        if plan is None:
+            self._repair_pending(patches)
+            return None
+        self._post_root_memo = None  # stale until apply_post_root
+        return PostRootPlan(plan, patches)
+
+    @staticmethod
+    def _account_leaf_segments(fields: Tuple[int, int, bytes]) -> Tuple[bytes, bytes]:
+        """(prefix, suffix) of the account-leaf RLP value around the
+        32-byte storage-root slot, derived structurally (never by byte
+        search — code hashes are attacker-influenced content)."""
+        nonce, balance, code_hash = fields
+        enc_n = rlp.encode(rlp.encode_uint(nonce))
+        enc_b = rlp.encode(rlp.encode_uint(balance))
+        value0 = rlp.encode(
+            [rlp.encode_uint(nonce), rlp.encode_uint(balance), b"\x00" * 32, code_hash]
+        )
+        payload_len = len(enc_n) + len(enc_b) + 66
+        off = (len(value0) - payload_len) + len(enc_n) + len(enc_b) + 1
+        return value0[:off], value0[off + 32 :]
+
+    def _repair_pending(self, patches: List[_RootPatch]) -> None:
+        """Plan build aborted after placeholder leaves were put: compute
+        the pending storage roots on the host and patch the real leaves
+        back in, leaving the tries exactly as state_root() would."""
+        for p in patches:
+            sroot = self._storage_ptries[p.addr].root_hash()
+            p.leaf.value = p.prefix + sroot + p.suffix
+            self._storage_root_memo[p.addr] = sroot
+            self._sroot_dirty.discard(p.addr)
+            self._applied_accounts[p.addr] = (
+                p.fields[0],
+                p.fields[1],
+                sroot,
+                p.fields[2],
+            )
+        if patches:
+            self._trie._enc_cache.clear()
+
+    def apply_post_root(
+        self, prp: PostRootPlan, digests: Sequence[bytes]
+    ) -> bytes:
+        """Fold a resolved plan's digests back into the host state: patch
+        each placeholder account leaf with its plan-computed storage root,
+        memoize, and return the post root (the plan's LAST out row). After
+        this the host tries are canonical again — a follow-up state_root()
+        returns the same root from the memo without hashing."""
+        for patch, sroot in zip(prp.patches, digests):
+            patch.leaf.value = patch.prefix + sroot + patch.suffix
+            self._storage_root_memo[patch.addr] = sroot
+            self._sroot_dirty.discard(patch.addr)
+            self._applied_accounts[patch.addr] = (
+                patch.fields[0],
+                patch.fields[1],
+                sroot,
+                patch.fields[2],
+            )
+        if prp.patches:
+            self._trie._enc_cache.clear()
+        root = bytes(digests[-1])
+        self._post_root_memo = root
+        return root
 
     def copy(self):  # pragma: no cover — stateless runs are one-shot
         raise StatelessError("WitnessStateDB cannot be copied")
+
+
+def _find_leaf(trie: PartialTrie, key: bytes) -> Optional[LeafNode]:
+    """The LeafNode object holding `key` (secure tries: all keys are
+    32-byte digests, so a present key always terminates in a leaf)."""
+    node, path = trie.root, list(bytes_to_nibbles(key))
+    while node is not None:
+        if isinstance(node, LeafNode):
+            return node if node.path == tuple(path) else None
+        if isinstance(node, ExtensionNode):
+            n = len(node.path)
+            if tuple(path[:n]) != node.path:
+                return None
+            node, path = node.child, path[n:]
+            continue
+        if isinstance(node, BranchNode):
+            if not path:
+                return None
+            node, path = node.children[path[0]], path[1:]
+            continue
+        return None  # HashNode: the put would have raised already
+    return None
+
+
+def _batched_root_wanted() -> bool:
+    """Route post roots through the serving root lane? PHANT_BATCHED_ROOT
+    =0 pins the host walk, =1 forces the lane (tests / XLA-CPU proxy);
+    auto engages it exactly when the device route exists (tpu backend +
+    live device) — on the pure-CPU path the host walk stays untouched and
+    nothing jax-adjacent is ever imported. The per-dispatch host-vs-
+    device decision stays with ops/root_engine.py (THE offload-gate
+    story): this is only the cheap 'could a device ever be involved'
+    pre-filter."""
+    import os
+
+    env = os.environ.get("PHANT_BATCHED_ROOT", "auto")
+    if env in ("0", "off", ""):
+        return False
+    if env == "1":
+        return True
+    from phant_tpu.backend import crypto_backend, jax_device_ok
+
+    return crypto_backend() == "tpu" and jax_device_ok()
+
+
+def compute_post_root(state: WitnessStateDB) -> bytes:
+    """The request path's post-state root.
+
+    Serving mode with a device in reach: build the request's fused
+    account+storage hash plan on THIS (handler) thread
+    (`post_root_plan` — host structural work, parallel across requests)
+    and submit it to the active scheduler's root lane, where concurrent
+    requests' plans coalesce into ONE device dispatch per level-shape
+    bucket (serving/scheduler.py submit_root, ops/root_engine.py). The
+    batch record the scheduler attaches folds into the open
+    `verify_block` span exactly like the witness path's. Everything
+    else — offline callers, pure-CPU serving, un-plannable tries —
+    is the host walk (`state_root()`), byte-identical by construction
+    and differential-tested."""
+    from phant_tpu.serving import active_scheduler
+
+    if _batched_root_wanted():
+        sched = active_scheduler()
+        if sched is not None and sched.accepts_root():
+            import os
+
+            from phant_tpu.utils.trace import metrics
+
+            # lone-request guard (THE offload-gate story, root_engine.py):
+            # plan construction itself costs ~a host walk's encoding, so
+            # a request with NO root work queued to coalesce with — and a
+            # witness payload the link model rejects alone — keeps the
+            # host walk WITHOUT building a plan. PHANT_BATCHED_ROOT=1
+            # forces the lane (tests/proxy); under concurrency the queue
+            # has company and every request plans.
+            if os.environ.get("PHANT_BATCHED_ROOT") != "1":
+                if sched.root_backlog() == 0:
+                    from phant_tpu.backend import device_offload_pays
+
+                    # witness bytes over-estimate the dirty-template
+                    # payload, so this only ever errs toward planning
+                    est = sum(map(len, state._db.values()))
+                    if not device_offload_pays(est):
+                        return state.state_root()
+            with metrics.phase("stateless.post_root_plan"):
+                prp = state.post_root_plan()
+            if prp is not None:
+                digests, meta = sched.root_traced(prp.plan)
+                if meta is not None:
+                    from phant_tpu.utils.trace import current_span
+
+                    sp = current_span()
+                    if sp is not None:
+                        sp.attrs.update(meta)
+                return state.apply_post_root(prp, digests)
+    return state.state_root()
 
 
 # ---------------------------------------------------------------------------
@@ -612,13 +1004,28 @@ def execute_stateless(
                 )
                 if fork is None and fork_factory is not None:
                     fork = fork_factory(state)
+                # verify_state_root=False: the post-root check moves to
+                # the dedicated phase below so it can ride the BATCHED
+                # root lane (run_block's inline check would pay the
+                # serial host walk first and leave nothing dirty for the
+                # plan path — pre-PR-11 the root was in fact computed
+                # TWICE per request, once here and once below)
                 chain = Blockchain(
-                    chain_id, state, parent_header, fork=fork, verify_state_root=True
+                    chain_id, state, parent_header, fork=fork, verify_state_root=False
                 )
             with metrics.phase("stateless.execute"):
                 result = chain.run_block(block)
             with metrics.phase("stateless.post_root"):
-                post_root = state.state_root()
+                # batched through the serving root lane when a device is
+                # in reach (ops/root_engine.py); host walk otherwise
+                post_root = compute_post_root(state)
+                if post_root != block.header.state_root:
+                    # the exact check (and error contract) run_block's
+                    # verify_state_root path would have applied
+                    raise BlockError(
+                        f"state root mismatch: {post_root.hex()} != "
+                        f"{block.header.state_root.hex()}"
+                    )
         except Exception as e:
             # by-kind counter (bounded cardinality: exception class names)
             metrics.count("stateless.errors", kind=type(e).__name__)

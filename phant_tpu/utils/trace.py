@@ -145,7 +145,8 @@ METRIC_HELP: Dict[str, str] = {
     "stateless.witness_decode": "Witness -> WitnessStateDB materialization phase",
     "stateless.witness_nodes_decoded": "Witness nodes decoded (digest map built) on the request path — exactly once per payload; a doubled count per payload is a reintroduced second decode",
     "stateless.execute": "Block execution phase over the witness-backed state",
-    "stateless.post_root": "Post-state-root recompute phase over the partial trie",
+    "stateless.post_root": "Post-state-root recompute phase over the partial trie (host walk or the batched root lane)",
+    "stateless.post_root_plan": "Fused account+storage hash-plan build on the request thread (WitnessStateDB.post_root_plan) before root-lane submission",
     # memoized witness engine
     "witness_engine.interned_nodes": "Unique trie nodes currently interned in the witness engine",
     "witness_engine.interned_digests": "Unique 32-byte digests currently interned (nodes + child refs)",
@@ -168,6 +169,15 @@ METRIC_HELP: Dict[str, str] = {
     # outcome, labels "0".."6", "7+", "u" (unreachable from the root)
     "witness_engine.depth_hits": "Witness-node cache hits by trie depth under the block root (depth-skewed reuse, PAPERS.md 2408.14217)",
     "witness_engine.depth_misses": "Witness-node cache misses (novel nodes) by trie depth under the block root",
+    # batched post-state roots (ops/root_engine.py)
+    "witness_engine.root_prefetch": "Root-lane prefetch stage: merging a batch's HashPlans into the pooled staging blob OFF the serving critical path (RootEngine.prefetch_batch)",
+    "witness_engine.root_pack": "Root-lane pack stage: offload-gate routing + plan merge (or prefetch-merge consumption) (RootEngine.begin_batch)",
+    "witness_engine.root_dispatch": "Root-lane dispatch stage: merged-program device enqueue, no host sync",
+    "witness_engine.root_resolve": "Root-lane resolve stage: out-row digest readback (device) or the per-plan host mirror",
+    "witness_engine.root_batches": "Root batches executed, by backend (device = merged dispatch; host = the offload-gated host walk)",
+    "witness_engine.root_requests": "Requests whose post root was computed through the root engine",
+    "witness_engine.root_plan_hits": "Root prefetch merges consumed by begin_batch (identity-matched plans list)",
+    "witness_engine.root_plan_stale": "Root prefetch merges dropped stale at begin time (shed changed the batch) — a perf miss, never a correctness event",
     # device-resident intern table (ops/witness_resident.py)
     "witness_resident.rows": "Rows resident on device (digest + child-ref rows, persistent across batches)",
     "witness_resident.uploaded_nodes": "Truly-novel nodes uploaded to the resident table (after the host prune)",
@@ -195,6 +205,9 @@ METRIC_HELP: Dict[str, str] = {
     "sched.prefetch_batches": "Witness batches whose decode + novelty pre-scan ran on the prefetch stage (scheduler worker or mesh lane) before pack",
     "sched.prefetch_wait": "Executor waits for a batch's prefetch plan — prefetch cost that did NOT hide under dispatch/resolve (the overlap audit against the witness_engine.prefetch phase)",
     "sched.prefetch_depth": "Assembled witness batches currently waiting on the prefetch worker (the lookahead occupancy)",
+    # root lane (batched post-state roots, serving/scheduler.py)
+    "sched.root_batches": "Root-lane batches executed by the scheduler, by backend (device/host per the offload gate)",
+    "sched.root_coalesced": "Root-lane requests that shared a coalesced root dispatch with at least one other request",
     # mesh-sharded dispatch (phant_tpu/serving/mesh_exec.py)
     "sched.mesh_devices": "Device lanes in the mesh executor pool (--sched-mesh)",
     "sched.device_queue_depth": "Witness batches queued on a mesh device lane, by device",

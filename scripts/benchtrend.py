@@ -95,6 +95,14 @@ def _direction(key: str) -> Optional[str]:
         # means the streaming-ingestion win is regressing (the overlap
         # speedup itself trend-gates via the _per_sec keys above)
         return "up"
+    if key.endswith("_speedup_pct"):
+        # post_root (round 11): the batched-vs-host median paired speedup
+        # — shrinking means the coalesced root dispatch is regressing
+        # toward the host walk. The section's A/A noise bar
+        # (`_noise_aa_pct`) and the lone-request parity echo
+        # (`_parity_pct`, asserted in-section against its own noise bar)
+        # fall through to informational.
+        return "up"
     if _PCTL_RE.search(key):
         return "down"
     if key.endswith("_ms") or key.endswith("_seconds") or key.endswith("_s"):

@@ -174,6 +174,9 @@ def main() -> int:
     rc = _pipeline_phase()
     if rc:
         return rc
+    rc = _post_root_phase()
+    if rc:
+        return rc
     return _qos_phase()
 
 
@@ -444,6 +447,128 @@ def _pipeline_phase() -> int:
         "[soak] pipeline phase green: depth-2 byte-identical, resolve- and "
         "prefetch-stage crashes fail only in-flight handles and name "
         "their stages"
+    )
+    return 0
+
+
+def _post_root_phase() -> int:
+    """Batched post-root soak (PR 11): the same request set through the
+    scheduler's root lane at pipeline depth 2 on the forced-device
+    (XLA-CPU proxy) route must be byte-identical to the host
+    `state_root()` oracle, and an induced ROOT-DISPATCH crash must fail
+    only in-flight requests with -32052 while leaving a stage-named
+    flight dump."""
+    import json
+
+    from phant_tpu.backend import set_crypto_backend
+    from phant_tpu.ops.root_engine import RootEngine
+    from phant_tpu.serving import (
+        SchedulerConfig,
+        SchedulerDown,
+        VerificationScheduler,
+    )
+    from phant_tpu.utils.jaxcache import enable_compile_cache
+
+    from test_post_root import _request_set
+
+    enable_compile_cache()  # warm from the pytest groups' persistent cache
+    failures: list = []
+    os.environ["PHANT_ALLOW_JAX_CPU"] = "1"
+    set_crypto_backend("tpu")
+    try:
+        hosts, prps, dbs = _request_set()
+        with VerificationScheduler(
+            config=SchedulerConfig(
+                max_batch=8,
+                max_wait_ms=10.0,
+                pipeline_depth=2,
+                root_engine_factory=lambda: RootEngine(device_floor=0),
+            ),
+        ) as s:
+            outs = s.root_many([p.plan for p in prps])
+            st = s.stats_snapshot()
+        for prp, db, out, want in zip(prps, dbs, outs, hosts):
+            if db.apply_post_root(prp, out) != want:
+                failures.append("batched post root diverged from the oracle")
+        if st["root_batches"] < 1:
+            failures.append(f"root lane never batched: {st}")
+    finally:
+        set_crypto_backend("cpu")
+
+    class _PoisonedRoot(RootEngine):
+        armed = False
+
+        def begin_batch(self, plans, prefetch=None):
+            if _PoisonedRoot.armed:
+                raise RuntimeError("soak-induced root dispatch crash")
+            return super().begin_batch(plans, prefetch=prefetch)
+
+    flight_dir = os.environ.get(
+        "PHANT_FLIGHT_DIR",
+        os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            "build",
+            "flight",
+        ),
+    )
+    os.makedirs(flight_dir, exist_ok=True)
+    before = set(os.listdir(flight_dir))
+    _PoisonedRoot.armed = False
+    hosts, prps, dbs = _request_set()
+    s = VerificationScheduler(
+        config=SchedulerConfig(
+            max_batch=8,
+            max_wait_ms=5.0,
+            pipeline_depth=2,
+            root_engine_factory=_PoisonedRoot,
+        ),
+    )
+    try:
+        first = [s.submit_root(p.plan) for p in prps[:2]]
+        pre = [f.result(timeout=60) for f in first]
+        _PoisonedRoot.armed = True
+        second = [s.submit_root(p.plan) for p in prps[2:]]
+        for f in second:
+            try:
+                f.result(timeout=60)
+                failures.append("in-flight root survived the dispatch crash")
+            except SchedulerDown as e:
+                if e.code != -32052:
+                    failures.append(f"wrong down code (root): {e.code}")
+        if [f.result(timeout=1) for f in first] != pre:
+            failures.append("already-resolved root digests lost after crash")
+    finally:
+        s.shutdown()
+    new_dumps = sorted(set(os.listdir(flight_dir)) - before)
+    crash_dumps = [d for d in new_dumps if "executor_crash" in d]
+    if not crash_dumps:
+        failures.append(f"no root-crash flight dump ({new_dumps})")
+    else:
+        with open(os.path.join(flight_dir, crash_dumps[-1])) as f:
+            dump = json.load(f)
+        crashes = [
+            r
+            for r in dump.get("records", [])
+            if r.get("kind") == "sched.executor_crash"
+        ]
+        if not crashes or crashes[-1].get("stage") not in (
+            "pack",
+            "dispatch",
+            "prefetch",
+        ):
+            failures.append(
+                f"root-crash dump does not name a dispatch-side stage: "
+                f"{crashes[-1] if crashes else None}"
+            )
+
+    if failures:
+        for f in failures:
+            print(f"[soak] FAIL (post-root phase): {f}", file=sys.stderr)
+        return 1
+    print(
+        "[soak] post-root phase green: depth-2 batched roots byte-identical, "
+        "induced root-dispatch crash fails only in-flight with a "
+        "stage-named dump"
     )
     return 0
 

@@ -382,3 +382,32 @@ def test_witness_stream_hit_rate_regression_flags(tmp_path):
     _write_round(tmp_path, 4, {"witness_stream_tiered_hit_rate": 0.41})
     rows, flags = benchtrend.analyze(str(tmp_path), 0.4, 2)
     assert any("witness_stream_tiered_hit_rate" in f for f in flags)
+
+
+def test_post_root_key_directions():
+    """Round-11 `post_root` section keys: the batched-vs-host median
+    paired speedup is higher-is-better (shrinking = the coalesced root
+    dispatch regressing toward the host walk), the batched/host root
+    rates trend via `_per_sec`, and the A/A noise bar + the lone-request
+    parity echo (asserted in-section, not trend-gated) stay
+    informational. Pinned so a suffix rework cannot un-gate the PR 11
+    claim."""
+    d = benchtrend._direction
+    assert d("post_root_coalesce_speedup_pct") == "up"
+    assert d("post_root_batched_roots_per_sec") == "up"
+    assert d("post_root_host_roots_per_sec") == "up"
+    assert d("post_root_coalesce_noise_aa_pct") is None
+    assert d("post_root_noise_aa_pct") is None
+    assert d("post_root_single_parity_pct") is None
+    assert d("post_root_batched_vs_host_pct") is None
+    assert d("post_root_requests") is None
+
+
+def test_post_root_speedup_regression_flags(tmp_path):
+    """A collapsed coalescing speedup must flag: per-request dispatches
+    creeping back onto the request path show exactly this signature."""
+    for n, s in enumerate([206.0, 198.0, 210.0], start=1):
+        _write_round(tmp_path, n, {"post_root_coalesce_speedup_pct": s})
+    _write_round(tmp_path, 4, {"post_root_coalesce_speedup_pct": 12.0})
+    rows, flags = benchtrend.analyze(str(tmp_path), 0.4, 2)
+    assert any("post_root_coalesce_speedup_pct" in f for f in flags)

@@ -804,8 +804,8 @@ def test_mesh_exec_is_in_hostsync_scope(mutated_tree, monkeypatch):
     p = mutated_tree / "phant_tpu" / "serving" / "mesh_exec.py"
     src = p.read_text()
     mutated = src.replace(
-        "                    verdicts = engine.resolve_batch(handle)\n",
-        "                    verdicts = engine.resolve_batch(handle)\n"
+        "                    verdicts = eng2.resolve_batch(handle)\n",
+        "                    verdicts = eng2.resolve_batch(handle)\n"
         "                    _n = verdicts.sum().item()\n",
         1,
     )
@@ -930,6 +930,39 @@ def test_resident_dispatch_is_in_hostsync_scope(mutated_tree, monkeypatch):
         if f.rule == "HOSTSYNC"
         and ".item()" in f.message
         and "witness_resident" in f.path
+    ]
+    assert hits, [f.render() for f in res.new]
+
+
+def test_root_engine_is_in_hostsync_scope(mutated_tree, monkeypatch):
+    """The batched post-root hot path (PR 11) is HOSTSYNC-scoped: plan
+    lowering (the prefetch merge) and the root_many dispatch exist to
+    enqueue the merged program with zero host syncs, so a reintroduced
+    `.item()` in the level-merge loop must turn the gate red."""
+    from phant_tpu.analysis.rules.hostsync import DEFAULT_ENTRIES
+
+    assert (
+        "phant_tpu.ops.root_engine.RootEngine.prefetch_batch"
+        in DEFAULT_ENTRIES
+    )
+    assert "phant_tpu.ops.root_engine.RootEngine.root_many" in DEFAULT_ENTRIES
+    p = mutated_tree / "phant_tpu" / "ops" / "root_engine.py"
+    src = p.read_text()
+    mutated = src.replace(
+        "        merged, outs = merge_plans(plans, blob_out=blob)\n",
+        "        merged, outs = merge_plans(plans, blob_out=blob)\n"
+        "        _sync = blob.sum().item()\n",
+        1,
+    )
+    assert mutated != src
+    p.write_text(mutated)
+    res = _analyze_repo_tree(mutated_tree, monkeypatch)
+    hits = [
+        f
+        for f in res.new
+        if f.rule == "HOSTSYNC"
+        and ".item()" in f.message
+        and "root_engine" in f.path
     ]
     assert hits, [f.render() for f in res.new]
 
