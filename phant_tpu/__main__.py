@@ -63,6 +63,17 @@ def build_parser() -> argparse.ArgumentParser:
         help="EVM bytecode interpreter: native C++ core (evmone-equivalent) "
         "or the pure-Python reference interpreter",
     )
+    p.add_argument(
+        "--commitment",
+        choices=("mpt", "binary"),
+        default=None,
+        help="Commitment scheme for stateless state verification "
+        "(phant_tpu/commitment/): hexary keccak MPT (the default) or "
+        "fixed-shape binary Merkle. Applies to every "
+        "engine_executeStatelessPayloadV1 this node serves — witnesses "
+        "and header state roots must commit under the same scheme. "
+        "Default: PHANT_COMMITMENT or mpt",
+    )
     # the Engine API is a localhost-trust interface; bind loopback by default
     p.add_argument("--host", type=str, default="127.0.0.1", help="Bind address")
     # observability surface (the Engine API port always serves GET /metrics
@@ -235,6 +246,15 @@ def main(argv=None) -> int:
 
     set_crypto_backend(args.crypto_backend)
     set_evm_backend(args.evm_backend)
+    if args.commitment is not None:
+        # the flag wins over the env; stateless.py / spec tooling read the
+        # active scheme through phant_tpu.commitment.active_scheme()
+        import os
+
+        os.environ["PHANT_COMMITMENT"] = args.commitment
+    from phant_tpu.commitment import active_scheme
+
+    log.info("commitment scheme: %s", active_scheme().name)
 
     # chain config resolution (reference: main.zig:109-114)
     if args.chainspec is not None:

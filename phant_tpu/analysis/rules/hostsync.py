@@ -68,6 +68,14 @@ DEFAULT_ENTRIES: Tuple[str, ...] = (
     # readback is annotated)
     "phant_tpu.ops.root_engine.RootEngine.prefetch_batch",
     "phant_tpu.ops.root_engine.RootEngine.root_many",
+    # pluggable commitment schemes (PR 12): the binary backend's witness
+    # pack loop (full-subtree node collection) and proof-path walk feed
+    # the serving differential/bench spans and the fixture-translation
+    # harness — pure host-bytes work by design; a reintroduced `.item()`
+    # or device readback in these walks would put a sync inside the
+    # per-block witness generation loop
+    "phant_tpu.commitment.binary.BinaryScheme.collect_nodes",
+    "phant_tpu.commitment.binary.BinaryScheme.proof_nodes",
 )
 
 _SCALAR_BUILTINS = ("int", "bool", "float")

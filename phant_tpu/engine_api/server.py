@@ -157,11 +157,17 @@ def _healthz_payload() -> tuple:
     from phant_tpu.version import RELEASE, revision
 
     global _healthz_dumped_for
+    from phant_tpu.commitment import active_scheme
+
     payload = {
         "status": "ok",
         "version": RELEASE,
         "revision": revision(),
         "uptime_s": round(time.monotonic() - _START_MONOTONIC, 1),
+        # how state is committed on this node (--commitment): a CL pairing
+        # with the wrong scheme sees every payload rejected on its state
+        # root, so the probe names the scheme explicitly
+        "commitment": active_scheme().name,
     }
     status = 200
     sched = active_scheduler()

@@ -271,7 +271,22 @@ def _delete(
 
 
 class Trie:
-    """A build-once/query MPT over byte keys."""
+    """A build-once/query MPT over byte keys.
+
+    The STRUCTURAL algorithms (insert / delete / branch collapse /
+    extension merge) are radix-generic: nothing in them assumes 16-way
+    branching beyond `children[digit]` indexing. Commitment-scheme
+    plugins (phant_tpu/commitment/) subclass with a different digit
+    alphabet and node codec — `_digits` maps a key to its path digits
+    (nibbles here; bits for the binary scheme) and `_path_enc` encodes a
+    leaf/extension path (hex-prefix here; bit-prefix for binary). Both
+    hooks default to the hexary-MPT behavior, byte-identical to the
+    pre-plugin code."""
+
+    #: key -> path digits (hexary: nibbles; binary scheme: bits)
+    _digits = staticmethod(bytes_to_nibbles)
+    #: leaf/extension path encoding (hexary: yellow-paper hex-prefix)
+    _path_enc = staticmethod(encode_hex_prefix)
 
     def __init__(self):
         self.root: Optional[Node] = None
@@ -298,17 +313,17 @@ class Trie:
         self.approx_size += 1
         # per-path cache eviction: untouched subtrees keep their encodings,
         # so a root after K updates re-encodes O(K * depth) nodes only
-        self.root = _insert(self.root, bytes_to_nibbles(key), value, self._evict)
+        self.root = _insert(self.root, self._digits(key), value, self._evict)
 
     def delete(self, key: bytes) -> None:
         """Remove `key` with full branch-collapse/extension-merge
         re-normalization (no-op when absent)."""
         self._epoch += 1
         self.approx_size = max(self.approx_size - 1, 0)
-        self.root = _delete(self.root, bytes_to_nibbles(key), self._evict)
+        self.root = _delete(self.root, self._digits(key), self._evict)
 
     def get(self, key: bytes) -> Optional[bytes]:
-        node, path = self.root, bytes_to_nibbles(key)
+        node, path = self.root, self._digits(key)
         while node is not None:
             if isinstance(node, LeafNode):
                 return node.value if node.path == tuple(path) else None
@@ -333,9 +348,9 @@ class Trie:
         if cached is not None:
             return cached
         if isinstance(node, LeafNode):
-            structure: rlp.RLPItem = [encode_hex_prefix(node.path, True), node.value]
+            structure: rlp.RLPItem = [self._path_enc(node.path, True), node.value]
         elif isinstance(node, ExtensionNode):
-            structure = [encode_hex_prefix(node.path, False), self._ref(node.child)]
+            structure = [self._path_enc(node.path, False), self._ref(node.child)]
         else:
             slots: List[rlp.RLPItem] = []
             for child in node.children:

@@ -144,7 +144,22 @@ class PlanBuilder:
 
     `try_subtree` visits one trie with rollback: a subtree containing an
     embedded (<32 B) or oversized node unwinds cleanly so the caller can
-    fall back to the host walk for THAT trie only."""
+    fall back to the host walk for THAT trie only.
+
+    Scheme hooks (phant_tpu/commitment/): `_path_enc` encodes a leaf/
+    extension path into its template (hex-prefix here; bit-prefix for the
+    binary scheme's BinaryPlanBuilder) and `_min_template` carries the
+    embedded-node rule (32 for hexary MPT — a <32 B encoding would have
+    been embedded in its parent, so a digest-per-node plan would be wrong;
+    0 for schemes that ALWAYS reference children by digest). Everything
+    else — level layout, hole wiring, `finish`, `merge_plans`, the device
+    executors — is scheme-independent: a HashPlan is just templates with
+    32-byte holes at byte offsets."""
+
+    #: leaf/extension path encoding (hexary default: hex-prefix)
+    _path_enc = staticmethod(encode_hex_prefix)
+    #: smallest plannable template (the hexary embedded-node rule)
+    _min_template = 32
 
     def __init__(self):
         # (level, template, [(hole_off, child_gi)])
@@ -171,13 +186,13 @@ class PlanBuilder:
             if vh is not None:
                 prefix, suffix, child_gi, child_level = vh
                 template, holes = _encode_template(
-                    [encode_hex_prefix(node.path, True), _ValueHole(prefix, suffix)]
+                    [self._path_enc(node.path, True), _ValueHole(prefix, suffix)]
                 )
                 level = child_level + 1
                 hole_refs: List[Tuple[int, int]] = [(holes[0], child_gi)]
             else:
                 template, _holes = _encode_template(
-                    [encode_hex_prefix(node.path, True), node.value]
+                    [self._path_enc(node.path, True), node.value]
                 )
                 level = 0
                 hole_refs = []
@@ -185,13 +200,13 @@ class PlanBuilder:
             ci, clvl, cdg = self.visit(node.child)
             if cdg is not None:
                 template, _holes = _encode_template(
-                    [encode_hex_prefix(node.path, False), cdg]
+                    [self._path_enc(node.path, False), cdg]
                 )
                 level = 0
                 hole_refs = []
             else:
                 template, holes = _encode_template(
-                    [encode_hex_prefix(node.path, False), _HOLE]
+                    [self._path_enc(node.path, False), _HOLE]
                 )
                 level = clvl + 1
                 hole_refs = [(holes[0], ci)]
@@ -214,7 +229,7 @@ class PlanBuilder:
             template, holes = _encode_template(items)
             level += 1  # -1 (all-constant children) -> level 0
             hole_refs = list(zip(holes, child_order))
-        if len(template) < 32:
+        if len(template) < self._min_template:
             self.too_small = True
         if len(template) > MPT_MAX_CHUNKS * RATE - 1:
             self.too_small = True  # oversized node: CPU path

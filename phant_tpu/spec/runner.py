@@ -115,66 +115,50 @@ def diff_post_state(fixture: Fixture, state: StateDB) -> None:
             )
 
 
-def _witness_of_state(accounts) -> tuple:
+def _witness_of_state(accounts, scheme=None) -> tuple:
     """(state_root, nodes, codes): the FULL state trie (accounts + storage
-    subtrees) as a witness. Fixture states are tiny, so the complete trie is
-    the simplest provably-sufficient witness — it exercises the whole
-    stateless machinery (partial-trie reads/writes, deletion collapse,
-    storage-root recompute) with every sibling available."""
-    from phant_tpu.mpt.mpt import BranchNode, ExtensionNode, Trie
-    from phant_tpu.state.root import build_state_trie, build_storage_trie
+    subtrees) as a witness under `scheme` (default: the hexary MPT —
+    byte-identical to the pre-plugin collection). Fixture states are tiny,
+    so the complete trie is the simplest provably-sufficient witness — it
+    exercises the whole stateless machinery (partial-trie reads/writes,
+    deletion collapse, storage-root recompute) with every sibling
+    available."""
+    from phant_tpu.commitment import get_scheme
 
-    nodes: dict = {}
-
-    def collect(trie: Trie) -> None:
-        if trie.root is None:
-            return
-
-        def walk(node):
-            _s, enc = trie.node_encoding(node)
-            if len(enc) >= 32 or node is trie.root:
-                nodes[enc] = None
-            if isinstance(node, ExtensionNode):
-                walk(node.child)
-            elif isinstance(node, BranchNode):
-                for child in node.children:
-                    if child is not None:
-                        walk(child)
-
-        walk(trie.root)
-
-    codes: dict = {}
-    for acct in accounts.values():
-        if acct.code:
-            codes[acct.code] = None
-        if any(v for v in acct.storage.values()):
-            collect(build_storage_trie(acct.storage))
-    trie = build_state_trie(accounts)
-    collect(trie)
-    return trie.root_hash(), list(nodes), list(codes)
+    if scheme is None:
+        scheme = get_scheme("mpt")
+    return scheme.witness_of_state(accounts)
 
 
-def run_fixture_stateless(fixture: Fixture) -> None:
+def run_fixture_stateless(fixture: Fixture, scheme=None) -> None:
     """The fixture oracle through `execute_stateless`: every valid block is
     re-executed from ONLY a witness of its pre-state (no resident StateDB)
     and must produce the header's post-state root; every expectException
     block must be rejected statelessly too. A full-state shadow chain rolls
     the canonical state forward between blocks (it is the witness source,
-    exactly the role a stateful node plays for a stateless client)."""
-    from phant_tpu.blockchain.fork import CancunFork, FrontierFork, PragueFork
+    exactly the role a stateful node plays for a stateless client).
+
+    `scheme` (phant_tpu/commitment/, default the process-wide active
+    scheme) selects the commitment scheme: under an alternate scheme the
+    fixture is first RE-COMMITTED (commitment/translate.py) so its headers
+    carry that scheme's state roots, and the shadow chain's root checks
+    run through the scheme instead of the MPT-only StateDB.state_root()."""
+    from phant_tpu.commitment import active_scheme
+    from phant_tpu.commitment.translate import fork_class_for, translate_fixture
+    from phant_tpu.blockchain.fork import FrontierFork
     from phant_tpu.stateless import StatelessError, execute_stateless
+
+    if scheme is None:
+        scheme = active_scheme()
+    is_mpt = scheme.name == "mpt"
+    if not is_mpt:
+        fixture = translate_fixture(fixture, scheme)
 
     # fork-varying system state (EIP-4788 beacon roots, EIP-2935 history)
     # is part of the post root, so the stateless side constructs the SAME
     # fork class over the witness-backed state (fork_factory) that the
     # shadow chain uses over the full state
-    net = fixture.network.lower()
-    if "prague" in net or "osaka" in net:
-        fork_cls = PragueFork
-    elif "cancun" in net:
-        fork_cls = CancunFork
-    else:
-        fork_cls = None  # stateless FrontierFork (no state binding)
+    fork_cls = fork_class_for(fixture.network)
 
     state = StateDB({addr: acct.copy() for addr, acct in fixture.pre.items()})
     genesis = Block.decode(fixture.genesis_rlp)
@@ -183,11 +167,15 @@ def run_fixture_stateless(fixture: Fixture) -> None:
         state=state,
         parent_header=genesis.header,
         fork=fork_cls(state) if fork_cls else None,
+        # an alternate scheme's headers carry THAT scheme's roots; the
+        # shadow's own MPT root check would reject them — the per-block
+        # scheme-root divergence check below replaces it
+        verify_state_root=is_mpt,
     )
 
     past_headers = [genesis.header]
     for i, fb in enumerate(fixture.blocks):
-        pre_root, nodes, codes = _witness_of_state(state.accounts)
+        pre_root, nodes, codes = _witness_of_state(state.accounts, scheme)
         parent = shadow.parent_header
         try:
             block = Block.decode(fb.rlp)
@@ -217,6 +205,7 @@ def run_fixture_stateless(fixture: Fixture) -> None:
                     nodes,
                     codes,
                     fork_factory=fork_factory,
+                    scheme=scheme,
                 )
                 stateless_ok = True
             except (StatelessError, BlockError, ValueError, KeyError, IndexError) as e:
@@ -245,7 +234,16 @@ def run_fixture_stateless(fixture: Fixture) -> None:
         # roll the canonical state forward for the next block's witness
         shadow.run_block(block)
         past_headers.append(block.header)
-        if shadow.state.state_root() != post_root:
+        # non-mpt: a full scheme-root rebuild per block (storage tries +
+        # state trie re-hashed from scratch) — fine at spec-fixture scale,
+        # deliberately NOT an incremental scheme trie; pointing the runner
+        # at a large corpus under an alternate scheme would want one
+        shadow_root = (
+            shadow.state.state_root()
+            if is_mpt
+            else scheme.state_root_of(shadow.state.accounts)
+        )
+        if shadow_root != post_root:
             raise FixtureFailure(
                 f"{fixture.name}: block {i} stateless/full state-root divergence"
             )
@@ -259,12 +257,14 @@ def run_fixture_stateless(fixture: Fixture) -> None:
     diff_post_state(fixture, state)
 
 
-def run_directory(root: Path, stateless: bool = False) -> RunStats:
+def run_directory(root: Path, stateless: bool = False, scheme=None) -> RunStats:
     stats = RunStats()
-    runner = run_fixture_stateless if stateless else run_fixture
     for path, fixture in walk_fixtures(root):
         try:
-            runner(fixture)
+            if stateless:
+                run_fixture_stateless(fixture, scheme=scheme)
+            else:
+                run_fixture(fixture)
             stats.passed += 1
         except Exception as e:  # noqa: BLE001 — collect everything for the report
             stats.failed += 1
@@ -290,9 +290,35 @@ def main() -> int:
         "scheduler (phant_tpu/serving/) — the IDENTICAL batching code the "
         "Engine API serves with, for serving-path parity runs",
     )
+    parser.add_argument(
+        "--commitment",
+        choices=("mpt", "binary"),
+        default=None,
+        help="commitment scheme (phant_tpu/commitment/): an alternate "
+        "scheme re-commits each fixture's chain (headers re-sealed with "
+        "that scheme's state roots) and verifies it through the identical "
+        "stateless machinery — the reproducible fixture-translation "
+        "differential run (requires --stateless). "
+        "Default: PHANT_COMMITMENT or mpt",
+    )
     args = parser.parse_args()
     if not args.root.is_dir():
         parser.error(f"fixture directory not found: {args.root}")
+    from phant_tpu.commitment import active_scheme, get_scheme
+
+    # the flag wins; a stateless run without it honors the process-wide
+    # PHANT_COMMITMENT contract exactly like the serving CLI
+    # (__main__.py). The STATEFUL oracle is scheme-irrelevant, so a
+    # merely-INHERITED env selection is ignored there — only an explicit
+    # contradictory flag errors.
+    if args.commitment:
+        scheme = get_scheme(args.commitment)
+        if scheme.name != "mpt" and not args.stateless:
+            parser.error(
+                "--commitment only affects stateless runs; add --stateless"
+            )
+    else:
+        scheme = active_scheme() if args.stateless else None
     sched = None
     if args.sched:
         from phant_tpu.serving import VerificationScheduler, install, uninstall
@@ -300,7 +326,7 @@ def main() -> int:
         sched = VerificationScheduler()
         install(sched)
     try:
-        stats = run_directory(args.root, stateless=args.stateless)
+        stats = run_directory(args.root, stateless=args.stateless, scheme=scheme)
     finally:
         if sched is not None:
             uninstall(sched)

@@ -35,12 +35,16 @@ def storage_root(storage: Mapping[int, int]) -> bytes:
 
 
 def account_leaf(account: Account) -> bytes:
-    return rlp.encode([
-        rlp.encode_uint(account.nonce),
-        rlp.encode_uint(account.balance),
+    # ONE value-encoding definition across every producer
+    # (phant_tpu/commitment/ account_leaf_value)
+    from phant_tpu.commitment import account_leaf_value
+
+    return account_leaf_value(
+        account.nonce,
+        account.balance,
         storage_root(account.storage),
         account.code_hash(),
-    ])
+    )
 
 
 def build_state_trie(accounts: Mapping[bytes, Account]) -> Trie:

@@ -390,12 +390,18 @@ class StateDB:
                     self._storage_tries.pop(addr, None)
                     self._storage_dirty.pop(addr, None)
                 else:
-                    leaf = rlp.encode([
-                        rlp.encode_uint(acct.nonce),
-                        rlp.encode_uint(acct.balance),
+                    # ONE value-encoding definition across every producer
+                    # (phant_tpu/commitment/ account_leaf_value) — the
+                    # incremental path must never diverge from the
+                    # full-rebuild and stateless write-back paths
+                    from phant_tpu.commitment import account_leaf_value
+
+                    leaf = account_leaf_value(
+                        acct.nonce,
+                        acct.balance,
                         self._storage_root_incremental(addr, acct),
                         acct.code_hash(),
-                    ])
+                    )
                     self._root_trie.put(key, leaf)
         self._root_dirty.clear()
         # host recursion on purpose, even on --crypto_backend=tpu: the

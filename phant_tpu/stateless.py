@@ -43,7 +43,6 @@ from phant_tpu.mpt.mpt import (
     ExtensionNode,
     LeafNode,
     Trie,
-    bytes_to_nibbles,
     decode_hex_prefix,
 )
 from phant_tpu.state.statedb import StateDB
@@ -111,10 +110,15 @@ class PartialTrie(Trie):
     requests' plans into one dispatch, which is where the device wins
     (WitnessStateDB.post_root_plan / compute_post_root)."""
 
+    #: digest -> decoded node graph (scheme hook: the hexary witness
+    #: decoder here; the binary scheme swaps in its strict 2-ary decoder,
+    #: phant_tpu/commitment/binary.py)
+    _resolve_witness = staticmethod(_resolve)
+
     def __init__(self, root_digest: bytes, db: Dict[bytes, bytes]):
-        super().__init__()
+        Trie.__init__(self)
         if root_digest != EMPTY_TRIE_ROOT:
-            node = _resolve(root_digest, db)
+            node = self._resolve_witness(root_digest, db)
             if isinstance(node, HashNode):
                 raise StatelessError("witness is missing the root node")
             self.root = node
@@ -123,7 +127,7 @@ class PartialTrie(Trie):
     # --- reads ------------------------------------------------------------
 
     def get(self, key: bytes) -> Optional[bytes]:
-        node, path = self.root, bytes_to_nibbles(key)
+        node, path = self.root, self._digits(key)
         while node is not None:
             if isinstance(node, HashNode):
                 raise StatelessError(
@@ -149,7 +153,7 @@ class PartialTrie(Trie):
             self.delete(key)
             return
         self._enc_cache.clear()
-        self.root = _insert_partial(self.root, bytes_to_nibbles(key), value)
+        self.root = _insert_partial(self.root, self._digits(key), value)
 
     def delete(self, key: bytes) -> None:
         """Remove `key` with full node collapse. Raises StatelessError when
@@ -161,7 +165,7 @@ class PartialTrie(Trie):
 
         self._enc_cache.clear()
         try:
-            self.root = _delete(self.root, bytes_to_nibbles(key))
+            self.root = _delete(self.root, self._digits(key))
         except _Unresolved:
             # _delete mutates in place on the way down, so the trie is now
             # half-deleted (key gone, collapse pending) — poison it so no
@@ -330,7 +334,14 @@ class WitnessStateDB(StateDB):
 
     `node_db` hands in the witness's digest -> node map decoded earlier
     on the request path (witness_node_db) so each witness is decoded
-    exactly once; None decodes here (offline/test callers)."""
+    exactly once; None decodes here (offline/test callers).
+
+    `scheme` selects the commitment scheme (phant_tpu/commitment/) the
+    witness commits state under — the partial tries, the node codec and
+    the post-root hash-plan lowering all resolve through it. None means
+    the process-wide active scheme (PHANT_COMMITMENT / `--commitment`,
+    default the hexary `mpt` scheme, byte-identical to the pre-plugin
+    path)."""
 
     def __init__(
         self,
@@ -338,11 +349,20 @@ class WitnessStateDB(StateDB):
         nodes: List[bytes],
         codes: List[bytes],
         node_db: Optional[Dict[bytes, bytes]] = None,
+        scheme=None,
     ):
         super().__init__()
+        if scheme is None:
+            from phant_tpu.commitment import active_scheme
+
+            scheme = active_scheme()
+        from phant_tpu.utils.trace import metrics
+
+        self._scheme = scheme
+        metrics.count("commitment.state_views", scheme=scheme.name)
         self._db = node_db if node_db is not None else witness_node_db(nodes)
         self._codes = {keccak256(c): c for c in codes}
-        self._trie = PartialTrie(state_root, self._db)
+        self._trie = scheme.partial_trie(state_root, self._db)
         self._seen: set = set()
         self._storage_roots: Dict[bytes, bytes] = {}
         self._storage_ptries: Dict[bytes, PartialTrie] = {}
@@ -417,7 +437,7 @@ class WitnessStateDB(StateDB):
             return
         strie = self._storage_ptries.get(addr)
         if strie is None:
-            strie = PartialTrie(sroot, self._db)
+            strie = self._scheme.partial_trie(sroot, self._db)
             self._storage_ptries[addr] = strie
         raw = strie.get(keccak256(slot.to_bytes(32, "big")))
         if raw is not None:
@@ -509,9 +529,9 @@ class WitnessStateDB(StateDB):
     def _account_leaf_value(
         nonce: int, balance: int, sroot: bytes, code_hash: bytes
     ) -> bytes:
-        return rlp.encode(
-            [rlp.encode_uint(nonce), rlp.encode_uint(balance), sroot, code_hash]
-        )
+        from phant_tpu.commitment import account_leaf_value
+
+        return account_leaf_value(nonce, balance, sroot, code_hash)
 
     def _delete_account_leaf(self, addr: bytes, key: bytes) -> bool:
         """Delete the account's leaf if the trie currently holds one
@@ -592,7 +612,7 @@ class WitnessStateDB(StateDB):
         paths); the root itself is computed by the caller's path."""
         strie = self._storage_ptries.get(addr)
         if strie is None:
-            strie = PartialTrie(pre_root, self._db)
+            strie = self._scheme.partial_trie(pre_root, self._db)
             self._storage_ptries[addr] = strie
         self._post_root_memo = None  # the account leaf WILL change; an
         # abort before the recompute must not leave the old memo live
@@ -639,9 +659,7 @@ class WitnessStateDB(StateDB):
         as a constant, the same per-trie fallback trie_root_device
         applies. Either way the tries are left consistent: a follow-up
         state_root() is always correct (and cheap, via the memos)."""
-        from phant_tpu.ops.mpt_jax import PlanBuilder
-
-        builder = PlanBuilder()
+        builder = self._scheme.plan_builder()
         patches: List[_RootPatch] = []
         changed_any = False
         for addr in sorted(self._seen | set(self.accounts)):
@@ -721,8 +739,8 @@ class WitnessStateDB(StateDB):
         nonce, balance, code_hash = fields
         enc_n = rlp.encode(rlp.encode_uint(nonce))
         enc_b = rlp.encode(rlp.encode_uint(balance))
-        value0 = rlp.encode(
-            [rlp.encode_uint(nonce), rlp.encode_uint(balance), b"\x00" * 32, code_hash]
+        value0 = WitnessStateDB._account_leaf_value(
+            nonce, balance, b"\x00" * 32, code_hash
         )
         payload_len = len(enc_n) + len(enc_b) + 66
         off = (len(value0) - payload_len) + len(enc_n) + len(enc_b) + 1
@@ -776,8 +794,9 @@ class WitnessStateDB(StateDB):
 
 def _find_leaf(trie: PartialTrie, key: bytes) -> Optional[LeafNode]:
     """The LeafNode object holding `key` (secure tries: all keys are
-    32-byte digests, so a present key always terminates in a leaf)."""
-    node, path = trie.root, list(bytes_to_nibbles(key))
+    32-byte digests, so a present key always terminates in a leaf).
+    Radix-generic: walks whatever digit alphabet the trie's scheme uses."""
+    node, path = trie.root, list(trie._digits(key))
     while node is not None:
         if isinstance(node, LeafNode):
             return node if node.path == tuple(path) else None
@@ -963,6 +982,7 @@ def execute_stateless(
     codes: List[bytes],
     fork=None,
     fork_factory=None,
+    scheme=None,
 ):
     """Verify the witness, execute the block against it, and verify the post
     state root. Returns the BlockExecutionResult plus the computed post root.
@@ -973,6 +993,12 @@ def execute_stateless(
     partial trie, where they are part of the post root); a prebuilt `fork`
     instance is accepted for forks that own no state (FrontierFork preloaded
     with authenticated ancestor hashes).
+
+    `scheme` is the commitment scheme the witness and the header's state
+    roots commit under (phant_tpu/commitment/); None = the process-wide
+    active scheme (`--commitment`). Witness verification itself is
+    scheme-blind — the engine checks subtree-connectedness over the
+    scheme's own node encodings.
 
     Observability: the whole run is one `span("verify_block", block=n)` —
     its JSON trace line carries the witness_verify / witness_decode /
@@ -1000,7 +1026,11 @@ def execute_stateless(
                 # counter-pinned contract (a second decode would double
                 # stateless.witness_nodes_decoded per payload)
                 state = WitnessStateDB(
-                    pre_state_root, nodes, codes, node_db=witness_node_db(nodes)
+                    pre_state_root,
+                    nodes,
+                    codes,
+                    node_db=witness_node_db(nodes),
+                    scheme=scheme,
                 )
                 if fork is None and fork_factory is not None:
                     fork = fork_factory(state)

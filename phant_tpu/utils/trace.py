@@ -218,6 +218,11 @@ METRIC_HELP: Dict[str, str] = {
     # observability layer (phant_tpu/obs/)
     "sched.watchdog_stalls": "Executor stalls detected by the obs watchdog (in-flight batch past its deadline)",
     "flight.dumps": "Flight-recorder postmortem dumps written, by trigger reason",
+    # commitment schemes (phant_tpu/commitment/)
+    "commitment.state_views": "Witness-backed state views constructed, by commitment scheme (mpt/binary) — the per-request scheme selector's audit trail",
+    "commitment.witness_nodes": "Witness nodes generated by full-state witness collection (spec runner / differential harnesses), by scheme",
+    "commitment.translated_fixtures": "Spec fixtures re-committed under an alternate commitment scheme (commitment/translate.py)",
+    "commitment.translated_blocks": "Fixture blocks re-sealed with alternate-scheme state roots during fixture translation",
     # crypto backend dispatch
     "keccak.batches": "Batched keccak dispatches by backend",
     "keccak.bytes": "Payload bytes submitted to batched keccak by backend",

@@ -177,6 +177,9 @@ def main() -> int:
     rc = _post_root_phase()
     if rc:
         return rc
+    rc = _commitment_phase()
+    if rc:
+        return rc
     return _qos_phase()
 
 
@@ -447,6 +450,145 @@ def _pipeline_phase() -> int:
         "[soak] pipeline phase green: depth-2 byte-identical, resolve- and "
         "prefetch-stage crashes fail only in-flight handles and name "
         "their stages"
+    )
+    return 0
+
+
+def _commitment_phase() -> int:
+    """Binary-backend soak (PR 12): a binary-Merkle witness span through
+    the depth-2 scheduler must produce verdicts byte-identical to the
+    direct engine oracle (corrupt blocks included — the engine is
+    scheme-blind by the ref-transparency contract), and an induced crash
+    under binary traffic must fail only in-flight requests with -32052
+    plus a stage-named flight dump."""
+    import json
+
+    from phant_tpu.commitment import get_scheme
+    from phant_tpu.crypto.keccak import keccak256
+    from phant_tpu.ops.witness_engine import WitnessEngine
+    from phant_tpu.serving import (
+        SchedulerConfig,
+        SchedulerDown,
+        VerificationScheduler,
+    )
+    from phant_tpu.types.account import Account
+
+    failures: list = []
+    scheme = get_scheme("binary")
+    accounts = {
+        bytes([i % 250 + 1]) * 20: Account(
+            nonce=i % 4,
+            balance=i * 10**13 + 5,
+            storage=({j: j * 3 + 1 for j in range(1, 7)} if i % 9 == 0 else {}),
+        )
+        for i in range(1, 160)
+    }
+    root, nodes, _codes = scheme.witness_of_state(accounts)
+    wits = []
+    for k in range(48):
+        if k % 8 == 3:  # byte-flip corruption
+            bad = list(nodes)
+            bad[k % len(nodes)] = bad[k % len(nodes)][:-1] + bytes(
+                [bad[k % len(nodes)][-1] ^ 1]
+            )
+            wits.append((root, bad))
+        elif k % 8 == 6:  # wrong root
+            wits.append((bytes([k + 1]) * 32, list(nodes)))
+        else:
+            wits.append((root, list(nodes)))
+
+    oracle_eng = WitnessEngine()
+    oracle = [bool(v) for v in oracle_eng.verify_batch(wits)]
+    if not any(oracle) or all(oracle):
+        failures.append("binary span lost its accept/reject mix")
+    with VerificationScheduler(
+        engine=WitnessEngine(),
+        config=SchedulerConfig(
+            max_batch=16, max_wait_ms=10.0, queue_depth=4096, pipeline_depth=2
+        ),
+    ) as s:
+        got = [bool(v) for v in s.verify_many(wits)]
+    if got != oracle:
+        failures.append("scheduler verdicts diverge from the binary oracle")
+
+    # induced crash under binary traffic: only in-flight work dies (-32052)
+    class _Poisoned:
+        def __init__(self):
+            self._eng = WitnessEngine()
+            self.armed = False
+
+        def verify_batch(self, w):
+            return self._eng.verify_batch(w)
+
+        def begin_batch(self, w, prefetch=None):
+            return self._eng.begin_batch(w)
+
+        def abandon_batch(self, h):
+            self._eng.abandon_batch(h)
+
+        def resolve_batch(self, h):
+            if self.armed:
+                raise RuntimeError("soak-induced binary resolve crash")
+            return self._eng.resolve_batch(h)
+
+    flight_dir = os.environ.get(
+        "PHANT_FLIGHT_DIR",
+        os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            "build",
+            "flight",
+        ),
+    )
+    before = set(os.listdir(flight_dir)) if os.path.isdir(flight_dir) else set()
+    good = [w for w, ok in zip(wits, oracle) if ok]
+    poisoned = _Poisoned()
+    s = VerificationScheduler(
+        engine=poisoned,
+        config=SchedulerConfig(max_batch=8, max_wait_ms=5.0, pipeline_depth=2),
+    )
+    try:
+        first = [s.submit_witness(*w) for w in good[:8]]
+        if not all(f.result(timeout=30) for f in first):
+            failures.append("pre-crash binary batch not VALID")
+        poisoned.armed = True
+        second = [s.submit_witness(*w) for w in good[8:16]]
+        for f in second:
+            try:
+                f.result(timeout=30)
+                failures.append("in-flight binary request survived the crash")
+            except SchedulerDown as e:
+                if e.code != -32052:
+                    failures.append(f"wrong down code (binary): {e.code}")
+        if not all(f.result(timeout=1) for f in first):
+            failures.append("resolved binary verdicts lost after the crash")
+    finally:
+        s.shutdown()
+    new_dumps = sorted(set(os.listdir(flight_dir)) - before)
+    crash_dumps = [d for d in new_dumps if "executor_crash" in d]
+    if not crash_dumps:
+        failures.append(f"no binary-crash flight dump ({new_dumps})")
+    else:
+        with open(os.path.join(flight_dir, crash_dumps[-1])) as f:
+            dump = json.load(f)
+        crashes = [
+            r
+            for r in dump.get("records", [])
+            if r.get("kind") == "sched.executor_crash"
+        ]
+        if not crashes or not crashes[-1].get("stage"):
+            failures.append(
+                f"binary crash dump carries no stage: "
+                f"{crashes[-1] if crashes else None}"
+            )
+
+    if failures:
+        for f in failures:
+            print(f"[soak] FAIL (commitment phase): {f}", file=sys.stderr)
+        return 1
+    print(
+        "[soak] commitment phase green: binary span byte-identical through "
+        "the depth-2 scheduler, induced crash failed only in-flight "
+        "requests with -32052 and a stage-named dump"
     )
     return 0
 

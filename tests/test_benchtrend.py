@@ -411,3 +411,57 @@ def test_post_root_speedup_regression_flags(tmp_path):
     _write_round(tmp_path, 4, {"post_root_coalesce_speedup_pct": 12.0})
     rows, flags = benchtrend.analyze(str(tmp_path), 0.4, 2)
     assert any("post_root_coalesce_speedup_pct" in f for f in flags)
+
+
+def test_commitment_compare_key_directions():
+    """Round-12 `commitment_compare` section keys: the binary backend's
+    DETERMINISTIC witness-byte savings margin (`_savings_vs_mpt_pct`)
+    gates UP and the per-scheme witness bytes per block gate DOWN —
+    deliberately overriding the generic `_per_block` info suffix, which
+    exists for workload-shape echoes, because these keys ARE the
+    section's committed witness-size claim (2504.14069). The noisy
+    near-zero throughput margin, shape echoes and node counts stay
+    informational."""
+    d = benchtrend._direction
+    assert d("commitment_binary_witness_savings_vs_mpt_pct") == "up"
+    # the throughput margin is parity-within-noise on the proxy box with
+    # a near-zero baseline (relative-delta math would flag every in-noise
+    # sign flip) — informational; the _blocks_per_sec keys gate the real
+    # throughput claims
+    assert d("commitment_binary_throughput_vs_mpt_pct") is None
+    assert d("commitment_mpt_witness_bytes_per_block") == "down"
+    assert d("commitment_binary_witness_bytes_per_block") == "down"
+    assert d("commitment_mpt_blocks_per_sec") == "up"
+    assert d("commitment_binary_steady_blocks_per_sec") == "up"
+    assert d("commitment_mpt_nodes_per_block") is None
+    assert d("commitment_compare_blocks") is None
+    assert d("commitment_compare_accounts") is None
+    # the override is scoped: non-commitment `_bytes_per_block` keys keep
+    # their info-suffix behavior (the engine section's workload echo)
+    assert d("witness_bytes_per_block") is None
+
+
+def test_commitment_witness_bloat_flags(tmp_path):
+    """A fattened binary witness encoding must flag: the scheme's whole
+    reason to exist is the witness-size margin."""
+    for n, v in enumerate([5980.0, 6010.0, 5955.0], start=1):
+        _write_round(tmp_path, n, {"commitment_binary_witness_bytes_per_block": v})
+    _write_round(tmp_path, 4, {"commitment_binary_witness_bytes_per_block": 16000.0})
+    rows, flags = benchtrend.analyze(str(tmp_path), 0.4, 2)
+    assert any("commitment_binary_witness_bytes_per_block" in f for f in flags)
+
+
+def test_commitment_savings_collapse_flags(tmp_path):
+    """A collapsed savings-vs-mpt margin must flag (the binary backend
+    regressing toward — or past — the hexary baseline)."""
+    for n, v in enumerate([11.0, 11.4, 10.8], start=1):
+        _write_round(
+            tmp_path, n, {"commitment_binary_witness_savings_vs_mpt_pct": v}
+        )
+    _write_round(
+        tmp_path, 4, {"commitment_binary_witness_savings_vs_mpt_pct": 0.5}
+    )
+    rows, flags = benchtrend.analyze(str(tmp_path), 0.4, 2)
+    assert any(
+        "commitment_binary_witness_savings_vs_mpt_pct" in f for f in flags
+    )

@@ -1,0 +1,719 @@
+"""Pluggable commitment schemes (PR 12): the differential suite.
+
+The `binary` backend must be a full peer of the hexary `mpt` scheme:
+byte-identical verdict parity through every verification route (all
+three witness-engine cores, the fused device kernel, the resident
+table, the scheduler at pipeline depths 1 AND 2), post-root plan/host
+byte identity through the root lane, fixture translation verifying
+end-to-end (spec runner + Engine API over real HTTP), and the default
+`mpt` path byte-identical to the pre-plugin code (every pre-existing
+suite runs unmodified — this file only pins the NEW surface)."""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+from phant_tpu import rlp
+from phant_tpu.backend import set_crypto_backend
+from phant_tpu.commitment import active_scheme, get_scheme, scheme_names
+from phant_tpu.commitment.binary import (
+    BinaryTrie,
+    PartialBinaryTrie,
+    decode_binary_node,
+    decode_bit_prefix,
+    encode_bit_prefix,
+)
+from phant_tpu.crypto.keccak import keccak256
+from phant_tpu.mpt.mpt import EMPTY_TRIE_ROOT, BranchNode
+from phant_tpu.ops.witness_engine import WitnessEngine
+from phant_tpu.serving import (
+    SchedulerConfig,
+    SchedulerDown,
+    VerificationScheduler,
+    install,
+    uninstall,
+)
+from phant_tpu.stateless import StatelessError, WitnessStateDB
+from phant_tpu.types.account import Account
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+@pytest.fixture(params=["ext", "ctypes", "python"])
+def engine_core(request, monkeypatch):
+    """All three witness-engine cores: the binary backend must verify
+    identically on each (the engine is scheme-blind by the
+    ref-transparency contract)."""
+    monkeypatch.setenv(
+        "PHANT_ENGINE_NATIVE", "0" if request.param == "python" else "1"
+    )
+    monkeypatch.setenv(
+        "PHANT_ENGINE_EXT", "1" if request.param == "ext" else "0"
+    )
+    return request.param
+
+
+@pytest.fixture
+def forced_device(monkeypatch):
+    """Force the root lane + device route on the XLA-CPU proxy."""
+    monkeypatch.setenv("PHANT_ALLOW_JAX_CPU", "1")
+    monkeypatch.setenv("PHANT_BATCHED_ROOT", "1")
+    set_crypto_backend("tpu")
+    yield
+    set_crypto_backend("cpu")
+
+
+def _accounts(seed: int = 0, n: int = 24) -> dict:
+    out = {}
+    for i in range(1, n):
+        storage = (
+            {j: j + seed + 1 for j in range(1, 9)} if i in (5, 6, 7) else {}
+        )
+        out[bytes([i]) * 20] = Account(
+            nonce=i % 3, balance=i * 10**15 + seed, storage=storage
+        )
+    return out
+
+
+def _witness(scheme_name: str, seed: int = 0, n: int = 24):
+    """(root, nodes, codes) full-state witness under one scheme."""
+    return get_scheme(scheme_name).witness_of_state(_accounts(seed, n))
+
+
+# ---------------------------------------------------------------------------
+# the binary trie itself
+# ---------------------------------------------------------------------------
+
+
+def test_bit_prefix_roundtrip_and_strictness():
+    for n in (0, 1, 7, 8, 9, 31, 240, 248, 255, 256):
+        bits = tuple((i * 7 + n) % 2 for i in range(n))
+        for leaf in (True, False):
+            enc = encode_bit_prefix(bits, leaf)
+            assert decode_bit_prefix(enc) == (bits, leaf)
+            assert len(enc) == 2 + (n + 7) // 8
+    # strictness: unknown flag bits, bad lengths, nonzero pad bits
+    with pytest.raises(ValueError):
+        decode_bit_prefix(b"\x40\x01\x80")  # unknown flag bit
+    with pytest.raises(ValueError):
+        decode_bit_prefix(b"\x20\x09\x80")  # 9 bits need 2 path bytes
+    with pytest.raises(ValueError):
+        decode_bit_prefix(b"\x20\x01\x41")  # pad bits set
+    with pytest.raises(ValueError):
+        # count past the 256-bit key space (257..511 fits the 9-bit field
+        # but can never be a real path — decode must stay encode's strict
+        # inverse)
+        decode_bit_prefix(b"\x21\x2c" + b"\x00" * 38)
+    with pytest.raises(ValueError):
+        encode_bit_prefix((0,) * 257, True)
+
+
+def test_binary_trie_against_model():
+    trie, model = BinaryTrie(), {}
+    for i in range(400):
+        k = keccak256(i.to_bytes(4, "big"))
+        trie.put(k, b"v%d" % i)
+        model[k] = b"v%d" % i
+    assert all(trie.get(k) == v for k, v in model.items())
+    assert trie.get(keccak256(b"absent")) is None
+    # delete half; root must equal a fresh build of the survivors
+    for i in range(0, 400, 2):
+        k = keccak256(i.to_bytes(4, "big"))
+        trie.delete(k)
+        del model[k]
+    rebuilt = BinaryTrie()
+    for k, v in sorted(model.items()):
+        rebuilt.put(k, v)
+    assert trie.root_hash() == rebuilt.root_hash()
+    assert BinaryTrie().root_hash() == EMPTY_TRIE_ROOT
+
+
+def test_binary_nodes_are_strictly_2ary_and_fixed_shape():
+    """Every witness node decodes under the strict binary codec; internal
+    nodes are the FIXED 83-byte 2-ary frame (both children present,
+    slots 2..16 empty)."""
+    scheme = get_scheme("binary")
+    root, nodes, _codes = _witness("binary")
+    db = {keccak256(n): n for n in nodes}
+    internal = 0
+    for enc in nodes:
+        item = rlp.decode(enc)
+        node = decode_binary_node(item, db)  # strict codec must accept
+        if isinstance(item, list) and len(item) == 17:
+            internal += 1
+            assert len(enc) == 83  # fixed-shape 2-ary frame
+            assert isinstance(node, BranchNode)
+            assert node.children[0] is not None and node.children[1] is not None
+            assert all(c is None for c in node.children[2:])
+            assert node.value is None
+    assert internal > 0
+    # and the decoded graph re-roots identically
+    assert PartialBinaryTrie(root, db).root_hash() == root
+
+
+def test_binary_codec_rejects_malformed():
+    db: dict = {}
+    l32 = b"\x11" * 32
+    with pytest.raises(StatelessError):  # 3 children
+        decode_binary_node([l32, l32, l32] + [b""] * 14, db)
+    with pytest.raises(StatelessError):  # value on a branch
+        decode_binary_node([l32, l32] + [b""] * 14 + [b"\x01"], db)
+    with pytest.raises(StatelessError):  # embedded (list) child
+        decode_binary_node([[b"\x20\x00", b"x"], l32] + [b""] * 15, db)
+    with pytest.raises(StatelessError):  # missing branch child
+        decode_binary_node([b"", l32] + [b""] * 15, db)
+    with pytest.raises(StatelessError):  # non-canonical path (pad bits)
+        decode_binary_node([b"\x20\x01\x41", b"v"], db)
+    with pytest.raises(StatelessError):  # extension with empty path
+        decode_binary_node([b"\x00\x00", l32], db)
+    with pytest.raises(StatelessError):  # wrong arity
+        decode_binary_node([l32, l32, l32], db)
+
+
+# ---------------------------------------------------------------------------
+# witness verification: accept/reject parity on every route
+# ---------------------------------------------------------------------------
+
+#: corruption classes applied identically to either scheme's witness;
+#: each returns (root, nodes) and the expected verdict
+def _corruptions(root, nodes):
+    flip = list(nodes)
+    flip[2] = flip[2][:-1] + bytes([flip[2][-1] ^ 1])
+    root_node = next(n for n in nodes if keccak256(n) == root)
+    dropped_root = [n for n in nodes if n is not root_node]
+    foreign = list(nodes) + [rlp.encode([b"\x20\x00", b"orphan-value"])]
+    return [
+        ("intact", root, list(nodes), True),
+        ("byte_flip", root, flip, False),
+        ("wrong_root", bytes([0x42]) * 32, list(nodes), False),
+        ("dropped_root_node", root, dropped_root, False),
+        ("unlinked_foreign_node", root, foreign, False),
+        ("empty", root, [], False),
+    ]
+
+
+def test_accept_reject_parity_all_cores(engine_core):
+    """The differential contract: both schemes accept/reject the same
+    corruption classes on the same state, on every engine core."""
+    verdicts = {}
+    for name in ("mpt", "binary"):
+        root, nodes, _codes = _witness(name)
+        eng = WitnessEngine(max_nodes=1 << 16)
+        for cls, r, nl, want in _corruptions(root, nodes):
+            got = eng.verify(r, nl)
+            assert got == want, (engine_core, name, cls)
+            verdicts.setdefault(cls, set()).add(got)
+    # parity: no class may split across schemes
+    assert all(len(v) == 1 for v in verdicts.values()), verdicts
+
+
+def test_scheduler_differential_depths(engine_core):
+    """verify_many (the Engine API's batching path) must be
+    byte-identical to the direct engine on binary witnesses at pipeline
+    depths 1 AND 2, mixed accept/reject traffic included."""
+    root, nodes, _codes = _witness("binary")
+    cases = _corruptions(root, nodes)
+    wits = [(r, nl) for _c, r, nl, _w in cases for _ in range(3)]
+    expected = [w for _c, _r, _nl, w in cases for _ in range(3)]
+    direct = [
+        bool(v) for v in WitnessEngine(max_nodes=1 << 16).verify_batch(wits)
+    ]
+    assert direct == expected
+    for depth in (1, 2):
+        with VerificationScheduler(
+            engine=WitnessEngine(max_nodes=1 << 16),
+            config=SchedulerConfig(
+                max_batch=8, max_wait_ms=5.0, queue_depth=4096,
+                pipeline_depth=depth,
+            ),
+        ) as sched:
+            got = [bool(v) for v in sched.verify_many(wits)]
+        assert got == direct, (engine_core, depth)
+
+
+def test_fused_device_kernel_binary(monkeypatch):
+    """The fused on-device ref-extraction kernel verifies binary
+    witnesses identically to the host oracle — the device half of the
+    ref-transparency contract (XLA-CPU proxy)."""
+    monkeypatch.setenv("PHANT_ALLOW_JAX_CPU", "1")
+    import jax.numpy as jnp
+    import numpy as np
+
+    from phant_tpu.ops.witness_jax import (
+        WITNESS_MAX_CHUNKS,
+        pack_witness_fused,
+        roots_to_words,
+        witness_verify_fused,
+    )
+
+    root, nodes, _codes = _witness("binary")
+    cases = _corruptions(root, nodes)
+    cases = [c for c in cases if c[2]]  # the kernel packs nonempty lists
+    blob, meta16 = pack_witness_fused(
+        [nl for _c, _r, nl, _w in cases], WITNESS_MAX_CHUNKS
+    )
+    got = witness_verify_fused(
+        jnp.asarray(blob),
+        jnp.asarray(meta16),
+        jnp.asarray(roots_to_words([r for _c, r, _nl, _w in cases])),
+        max_chunks=WITNESS_MAX_CHUNKS,
+        n_blocks=len(cases),
+    )
+    assert list(np.asarray(got)) == [w for _c, _r, _nl, w in cases]
+
+
+def test_resident_table_binary(forced_device, monkeypatch):
+    """The device-resident intern table serves binary witnesses with
+    verdicts identical to the host oracle (PHANT_RESIDENT=1 proxy)."""
+    monkeypatch.setenv("PHANT_RESIDENT", "1")
+    root, nodes, _codes = _witness("binary")
+    cases = _corruptions(root, nodes)
+    wits = [(r, nl) for _c, r, nl, _w in cases if nl]
+    want = [w for _c, _r, nl, w in cases if nl]
+    eng = WitnessEngine(max_nodes=1 << 16, resident=True)
+    try:
+        got = [bool(v) for v in eng.verify_batch(wits)]
+        assert got == want
+        # steady state: the same batch again is all-hit, same verdicts
+        assert [bool(v) for v in eng.verify_batch(wits)] == want
+        assert eng.stats.get("resident_batches", eng.stats.get("hashed")) is not None
+    finally:
+        eng.reset()
+
+
+# ---------------------------------------------------------------------------
+# witness-backed state + post roots
+# ---------------------------------------------------------------------------
+
+
+def _mutate(db: WitnessStateDB) -> None:
+    """Every mutation class: storage update + zeroing collapse, balance
+    update, create with storage, EIP-158-style delete, selfdestruct-
+    recreate (identity change)."""
+    a5, a6, a7 = bytes([5]) * 20, bytes([6]) * 20, bytes([7]) * 20
+    db.set_storage(a5, 1, 4242)
+    db.set_storage(a5, 3, 0)  # zeroing -> delete with collapse
+    db.get_balance(a6)
+    db.accounts[a6].balance += 5
+    new = b"\xee" * 20
+    db.get_balance(new)
+    db.accounts[new] = Account(balance=123)
+    db.set_storage(new, 9, 99)
+    gone = bytes([9]) * 20
+    db.get_balance(gone)
+    del db.accounts[gone]
+    db.get_balance(a7)  # selfdestruct-recreate: fresh identity, empty storage
+    db.accounts[a7] = Account(balance=1)
+
+
+def _post_accounts() -> dict:
+    post = {a: acct.copy() for a, acct in _accounts().items()}
+    post[bytes([5]) * 20].storage[1] = 4242
+    del post[bytes([5]) * 20].storage[3]
+    post[bytes([6]) * 20].balance += 5
+    post[b"\xee" * 20] = Account(balance=123, storage={9: 99})
+    del post[bytes([9]) * 20]
+    post[bytes([7]) * 20] = Account(balance=1)
+    return post
+
+
+@pytest.mark.parametrize("scheme_name", ["mpt", "binary"])
+def test_statedb_mutation_classes_host_walk(scheme_name):
+    scheme = get_scheme(scheme_name)
+    root, nodes, codes = _witness(scheme_name)
+    db = WitnessStateDB(root, nodes, codes, scheme=scheme)
+    _mutate(db)
+    want = scheme.state_root_of(_post_accounts())
+    assert db.state_root() == want
+    assert db.state_root() == want  # memoized repeat
+
+
+def test_binary_post_root_plan_host_mirror():
+    """The binary hash-plan path (BinaryPlanBuilder -> HashPlan) is
+    byte-identical to the host walk through the CPU plan mirror."""
+    from phant_tpu.ops.mpt_jax import execute_plan_outputs_host
+
+    scheme = get_scheme("binary")
+    root, nodes, codes = _witness("binary")
+    db = WitnessStateDB(root, nodes, codes, scheme=scheme)
+    _mutate(db)
+    prp = db.post_root_plan()
+    assert prp is not None  # binary never embeds: always plannable
+    assert prp.patches  # dirty storage tries ride INSIDE the fused plan
+    got = db.apply_post_root(prp, execute_plan_outputs_host(prp.plan))
+    want = get_scheme("binary").state_root_of(_post_accounts())
+    assert got == want
+    assert db.state_root() == want  # tries left canonical
+
+
+def test_binary_root_lane_through_scheduler(forced_device):
+    """compute_post_root routes a binary request through the serving
+    root lane (merged device dispatch on the XLA-CPU proxy) and stays
+    byte-identical to the host walk."""
+    from phant_tpu.stateless import compute_post_root
+
+    scheme = get_scheme("binary")
+    root, nodes, codes = _witness("binary")
+    sched = VerificationScheduler(
+        config=SchedulerConfig(pipeline_depth=2)
+    )
+    install(sched)
+    try:
+        db = WitnessStateDB(root, nodes, codes, scheme=scheme)
+        _mutate(db)
+        got = compute_post_root(db)
+        stats = sched.stats_snapshot()
+        assert stats.get("root_batches", 0) >= 1
+    finally:
+        uninstall(sched)
+        sched.shutdown()
+    oracle = WitnessStateDB(root, nodes, codes, scheme=scheme)
+    _mutate(oracle)
+    assert got == oracle.state_root()
+
+
+def test_mixed_scheme_plans_merge_in_one_root_dispatch(forced_device):
+    """HashPlans are scheme-agnostic templates: one merged RootEngine
+    dispatch can carry an mpt plan and a binary plan and both come back
+    byte-identical to their host walks — the root lane needs no
+    per-scheme bucketing."""
+    from phant_tpu.ops.root_engine import RootEngine
+
+    plans, wants = [], []
+    for name in ("mpt", "binary"):
+        scheme = get_scheme(name)
+        root, nodes, codes = _witness(name)
+        db = WitnessStateDB(root, nodes, codes, scheme=scheme)
+        _mutate(db)
+        prp = db.post_root_plan()
+        assert prp is not None
+        plans.append((db, prp))
+        oracle = WitnessStateDB(root, nodes, codes, scheme=scheme)
+        _mutate(oracle)
+        wants.append(oracle.state_root())
+    eng = RootEngine()
+    outs = eng.root_many([prp.plan for _db, prp in plans])
+    for (db, prp), out, want in zip(plans, outs, wants):
+        assert db.apply_post_root(prp, out) == want
+
+
+def test_deletion_collapse_insufficiency_parity():
+    """A deletion whose branch collapse crosses an unwitnessed sibling
+    raises StatelessError on BOTH schemes (path-only witnesses)."""
+    for name in ("mpt", "binary"):
+        scheme = get_scheme(name)
+        accounts = _accounts()
+        trie = scheme.build_state_trie(accounts)
+        target = bytes([5]) * 20
+        nodes = {}
+        for enc in scheme.proof_nodes(trie, keccak256(target)):
+            nodes[enc] = None
+        db = WitnessStateDB(trie.root_hash(), list(nodes), [], scheme=scheme)
+        db.get_balance(target)
+        del db.accounts[target]
+        with pytest.raises(StatelessError):
+            db.state_root()
+
+
+# ---------------------------------------------------------------------------
+# fixture translation + spec runner + Engine API
+# ---------------------------------------------------------------------------
+
+FIXTURES = REPO / "tests" / "fixtures"
+
+
+def _first_fixture(subdir: str):
+    from phant_tpu.spec.fixtures import walk_fixtures
+
+    for _path, fixture in walk_fixtures(FIXTURES / subdir):
+        return fixture
+    raise AssertionError(f"no fixtures under {subdir}")
+
+
+def test_translate_fixture_reroots_and_relinks():
+    from phant_tpu.commitment.translate import translate_fixture
+    from phant_tpu.types.block import Block
+
+    fixture = _first_fixture("cancun")
+    scheme = get_scheme("binary")
+    tr = translate_fixture(fixture, scheme)
+    assert tr.name.endswith("[binary]")
+    genesis = Block.decode(tr.genesis_rlp)
+    orig_genesis = Block.decode(fixture.genesis_rlp)
+    # oracle: the pre-state AFTER fork construction (system-contract
+    # pre-deploys are part of genesis state), committed under the scheme
+    from phant_tpu.commitment.translate import fork_class_for
+    from phant_tpu.state.statedb import StateDB
+
+    pre = StateDB({a: acct.copy() for a, acct in fixture.pre.items()})
+    fork_cls = fork_class_for(fixture.network)
+    if fork_cls is not None:
+        fork_cls(pre)  # pre-deploys mutate the genesis state
+    assert genesis.header.state_root == scheme.state_root_of(pre.accounts)
+    assert genesis.header.state_root != orig_genesis.header.state_root
+    parent = genesis.header
+    for fb, orig in zip(tr.blocks, fixture.blocks):
+        if fb.expect_exception:
+            assert fb.rlp == orig.rlp  # carried over untranslated
+            continue
+        block = Block.decode(fb.rlp)
+        assert block.header.parent_hash == parent.hash()  # re-linked
+        parent = block.header
+    assert tr.last_block_hash == parent.hash()
+    # mpt is the identity translation
+    assert translate_fixture(fixture, get_scheme("mpt")) is fixture
+
+
+@pytest.mark.parametrize("subdir", ["cancun", "prague"])
+def test_spec_fixture_stateless_both_schemes(subdir):
+    """One real fixture per fork family, end-to-end stateless under BOTH
+    schemes (the full 95/95 sweep is the CLI differential run:
+    `python -m phant_tpu.spec.runner tests/fixtures --stateless
+    --commitment binary`)."""
+    from phant_tpu.spec.runner import run_fixture_stateless
+
+    fixture = _first_fixture(subdir)
+    run_fixture_stateless(fixture, scheme=get_scheme("mpt"))
+    run_fixture_stateless(fixture, scheme=get_scheme("binary"))
+
+
+def test_spec_runner_cli_binary(tmp_path):
+    """`--commitment binary` is reproducible from the CLI."""
+    import shutil
+
+    src = sorted((FIXTURES / "shanghai").rglob("*.json"))[0]
+    shutil.copy(src, tmp_path / src.name)
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    out = subprocess.run(
+        [
+            sys.executable,
+            "-m",
+            "phant_tpu.spec.runner",
+            str(tmp_path),
+            "--stateless",
+            "--commitment",
+            "binary",
+        ],
+        capture_output=True,
+        text=True,
+        timeout=600,
+        cwd=REPO,
+        env=env,
+    )
+    assert out.returncode == 0, out.stdout + out.stderr
+    assert ", 0 failed" in out.stdout
+    # and binary without --stateless is rejected loudly
+    out2 = subprocess.run(
+        [
+            sys.executable,
+            "-m",
+            "phant_tpu.spec.runner",
+            str(tmp_path),
+            "--commitment",
+            "binary",
+        ],
+        capture_output=True,
+        text=True,
+        timeout=120,
+        cwd=REPO,
+        env=env,
+    )
+    assert out2.returncode != 0
+
+
+def test_engine_api_http_binary_e2e(monkeypatch):
+    """engine_executeStatelessPayloadV1 over real HTTP with
+    `--commitment=binary`: a binary-rooted payload+witness is VALID, the
+    healthz probe names the scheme, and the SAME payload against an
+    `mpt` server is rejected on its state root (scheme mismatch is
+    loud, never silent)."""
+    from test_serving import _post, _stateless_request
+
+    from phant_tpu.blockchain.chain import Blockchain
+    from phant_tpu.engine_api.server import EngineAPIServer
+    from phant_tpu.state.statedb import StateDB
+    from phant_tpu.__main__ import make_genesis_parent_header
+
+    # build the request under the BINARY scheme: the serving suite's
+    # consensus-valid recipe, re-rooted through the scheme
+    monkeypatch.setenv("PHANT_COMMITMENT", "binary")
+    scheme = get_scheme("binary")
+    chain, rpc, _mpt_root = _stateless_request()
+    payload, _mpt_witness = rpc["params"]
+
+    from dataclasses import replace as dc_replace
+
+    from phant_tpu.crypto import secp256k1 as secp
+    from phant_tpu.signer.signer import address_from_pubkey
+    from phant_tpu.types.block import Block, BlockHeader
+    from phant_tpu.types.transaction import decode_tx
+    from phant_tpu.utils.hexutils import bytes_to_hex, hex_to_bytes
+
+    # the recipe's pre-state (defaults of _stateless_request), committed
+    # under binary, with path proofs for the three touched addresses
+    sender = address_from_pubkey(secp.pubkey_of(0xA1A1A1))
+    accounts = {sender: Account(balance=10**20)}
+    for i in range(1, 24):
+        accounts[bytes([i]) * 20] = Account(balance=i * 10**15)
+    pre_trie = scheme.build_state_trie(accounts)
+    nodes: dict = {}
+    recipient, coinbase = b"\x7e" * 20, b"\xc0" * 20
+    for a in (sender, recipient, coinbase):
+        for enc in scheme.proof_nodes(pre_trie, keccak256(a)):
+            nodes[enc] = None
+
+    # replay the payload's tx on a full chain to derive the binary post
+    # root, then re-seal the header (state root + block hash)
+    from phant_tpu.mpt.mpt import ordered_trie_root
+
+    parent = make_genesis_parent_header()
+    full = StateDB({a: acct.copy() for a, acct in accounts.items()})
+    builder = Blockchain(1, full, parent, verify_state_root=False)
+    tx = decode_tx(hex_to_bytes(payload["transactions"][0]))
+    draft_header = BlockHeader(
+        parent_hash=parent.hash(),
+        fee_recipient=coinbase,
+        block_number=1,
+        gas_limit=parent.gas_limit,
+        gas_used=int(payload["gasUsed"], 16),
+        timestamp=parent.timestamp + 12,
+        base_fee_per_gas=int(payload["baseFeePerGas"], 16),
+        withdrawals_root=EMPTY_TRIE_ROOT,
+        state_root=hex_to_bytes(payload["stateRoot"]),
+        # body roots stay hexary by design: the commitment scheme plugs
+        # STATE commitment; tx/receipt/withdrawal roots are body
+        # commitments the CL derives independently
+        transactions_root=ordered_trie_root([tx.encode()]),
+        receipts_root=hex_to_bytes(payload["receiptsRoot"]),
+        logs_bloom=hex_to_bytes(payload["logsBloom"]),
+    )
+    draft = Block(header=draft_header, transactions=(tx,), withdrawals=())
+    builder.apply_body(draft)
+    binary_post_root = scheme.state_root_of(full.accounts)
+    final_header = dc_replace(draft_header, state_root=binary_post_root)
+
+    payload = dict(payload)
+    payload["stateRoot"] = bytes_to_hex(binary_post_root)
+    payload["blockHash"] = bytes_to_hex(final_header.hash())
+    witness_json = {
+        "headers": [bytes_to_hex(parent.encode())],
+        "preStateRoot": bytes_to_hex(pre_trie.root_hash()),
+        "state": [bytes_to_hex(n) for n in nodes],
+        "codes": [],
+    }
+    rpc = {
+        "jsonrpc": "2.0",
+        "id": 9,
+        "method": "engine_executeStatelessPayloadV1",
+        "params": [payload, witness_json],
+    }
+
+    server = EngineAPIServer(chain, host="127.0.0.1", port=0)
+    server.serve_in_background()
+    try:
+        base = f"http://127.0.0.1:{server.port}"
+        import urllib.request
+
+        with urllib.request.urlopen(base + "/healthz", timeout=10) as r:
+            health = json.loads(r.read())
+        assert health["commitment"] == "binary"
+        code, body = _post(base, rpc)
+        assert code == 200, body
+        assert body["result"]["status"] == "VALID", body
+    finally:
+        server.shutdown()
+
+    # the SAME binary request against an mpt-committed server: rejected
+    monkeypatch.setenv("PHANT_COMMITMENT", "mpt")
+    chain2 = Blockchain(
+        1, StateDB(), make_genesis_parent_header(), verify_state_root=False
+    )
+    server = EngineAPIServer(chain2, host="127.0.0.1", port=0)
+    server.serve_in_background()
+    try:
+        base = f"http://127.0.0.1:{server.port}"
+        code, body = _post(base, rpc)
+        assert body.get("result", {}).get("status") != "VALID", body
+    finally:
+        server.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# plumbing: registry, CLI, crash parity
+# ---------------------------------------------------------------------------
+
+
+def test_registry_and_env_selection(monkeypatch):
+    assert set(scheme_names()) >= {"mpt", "binary"}
+    monkeypatch.delenv("PHANT_COMMITMENT", raising=False)
+    assert active_scheme().name == "mpt"
+    monkeypatch.setenv("PHANT_COMMITMENT", "binary")
+    assert active_scheme().name == "binary"
+    monkeypatch.setenv("PHANT_COMMITMENT", "verkle")
+    with pytest.raises(ValueError):
+        active_scheme()
+
+
+def test_cli_flag_parses():
+    from phant_tpu.__main__ import build_parser
+
+    args = build_parser().parse_args(["--commitment", "binary"])
+    assert args.commitment == "binary"
+    assert build_parser().parse_args([]).commitment is None
+
+
+def test_binary_crash_fails_only_inflight(engine_core):
+    """A poisoned engine under binary traffic: in-flight requests fail
+    with -32052, already-resolved verdicts survive — the overload
+    contract is scheme-independent."""
+    root, nodes, _codes = _witness("binary")
+
+    class _Poisoned:
+        def __init__(self):
+            self._eng = WitnessEngine(max_nodes=1 << 16)
+            self.armed = False
+
+        def verify_batch(self, w):
+            if self.armed:
+                raise RuntimeError("induced binary crash")
+            return self._eng.verify_batch(w)
+
+    poisoned = _Poisoned()
+    sched = VerificationScheduler(
+        engine=poisoned,
+        config=SchedulerConfig(max_batch=4, max_wait_ms=5.0, pipeline_depth=1),
+    )
+    try:
+        first = [sched.submit_witness(root, list(nodes)) for _ in range(4)]
+        assert all(f.result(timeout=30) for f in first)
+        poisoned.armed = True
+        second = [sched.submit_witness(root, list(nodes)) for _ in range(4)]
+        for f in second:
+            with pytest.raises(SchedulerDown) as exc:
+                f.result(timeout=30)
+            assert exc.value.code == -32052
+        assert all(f.result(timeout=1) for f in first)
+    finally:
+        sched.shutdown()
+
+
+def test_mpt_scheme_matches_statedb_root():
+    """The mpt scheme's state commitment is the StateDB's own root (the
+    byte-identity anchor for the default path)."""
+    from phant_tpu.state.statedb import StateDB
+
+    accounts = _accounts()
+    scheme = get_scheme("mpt")
+    assert scheme.state_root_of(accounts) == StateDB(
+        {a: acct.copy() for a, acct in accounts.items()}
+    ).state_root()
+    root, nodes, _codes = scheme.witness_of_state(accounts)
+    assert WitnessEngine(max_nodes=1 << 16).verify(root, nodes)

@@ -1000,3 +1000,39 @@ def test_prefetch_prescan_is_in_hostsync_scope(mutated_tree, monkeypatch):
         and "witness_engine" in f.path
     ]
     assert hits, [f.render() for f in res.new]
+
+
+def test_binary_commitment_pack_loop_is_in_hostsync_scope(
+    mutated_tree, monkeypatch
+):
+    """The binary commitment backend's hot paths (PR 12) are
+    HOSTSYNC-scoped: the witness pack loop (full-subtree node
+    collection) and the proof-path walk are in DEFAULT_ENTRIES, and a
+    reintroduced `.item()` inside the pack loop turns the gate red while
+    the committed baseline stays EMPTY."""
+    from phant_tpu.analysis.rules.hostsync import DEFAULT_ENTRIES
+
+    assert (
+        "phant_tpu.commitment.binary.BinaryScheme.collect_nodes"
+        in DEFAULT_ENTRIES
+    )
+    assert (
+        "phant_tpu.commitment.binary.BinaryScheme.proof_nodes"
+        in DEFAULT_ENTRIES
+    )
+    p = mutated_tree / "phant_tpu" / "commitment" / "binary.py"
+    src = p.read_text()
+    mutated = src.replace(
+        "            nodes[trie.node_encoding(node)[1]] = None\n",
+        "            nodes[trie.node_encoding(node)[1]] = None\n"
+        "            _n = node.digest.sum().item()\n",
+        1,
+    )
+    assert mutated != src
+    p.write_text(mutated)
+    res = _analyze_repo_tree(mutated_tree, monkeypatch)
+    hits = [
+        f for f in res.new if f.rule == "HOSTSYNC" and ".item()" in f.message
+    ]
+    assert hits, [f.render() for f in res.new]
+    assert any("commitment" in f.path for f in hits)
