@@ -100,6 +100,16 @@ root. Verifiers measured on the SAME span:
     dispatches, median paired vs its A/A bar — the committed claim),
     the honest batched-vs-host number (negative on the XLA-CPU proxy;
     the case for the offload gate), and the lone-request parity echo.
+  * sender_lane (device section) — coalesced sender recovery (round 14,
+    ops/sig_engine.py): sender byte-identity vs direct get_senders_batch
+    asserted in-section (invalid-signature and pre-EIP-155 blocks
+    included), the coalescing speedup (ONE merged ecrecover dispatch vs
+    K per-request dispatches, median paired vs its A/A bar — the
+    committed claim), the honest batched-vs-native number (negative on
+    the XLA-CPU proxy; the case for the merged offload gate), the
+    hidden-fraction audit (recovery resolved before the execute phase
+    needed it), and the lone-request gate (native path, zero merged
+    dispatches).
 
 The cold fused device kernel (everything incl. RLP ref parsing on device,
 ops/witness_jax.py witness_verify_fused) is timed honestly per batch, and
@@ -2597,6 +2607,311 @@ def sec_post_root() -> dict:
     return out
 
 
+def sec_sender_lane() -> dict:
+    """Coalesced sender recovery (PR 14, ops/sig_engine.py).
+
+    Four coupled measurements over K block-shaped tx lists (each BELOW
+    the per-request PHANT_TPU_MIN_ECRECOVER floor — the serving regime
+    the lane exists for):
+
+    (a) SENDER BYTE-IDENTITY, asserted in-section: every request's
+    sender slice through the FORCED-DEVICE merged dispatch must equal
+    the direct `get_senders_batch` / `recover_senders_async(force_cpu)`
+    oracle — including a block with an INVALID signature (same None
+    position, same `unrecoverable signature at tx index i` attribution)
+    and a pre-EIP-155 block (v=27/28 legacy signing).
+
+    (b) COALESCING SPEEDUP (the committed >noise-bar claim,
+    `sender_lane_coalesce_speedup_pct` vs
+    `sender_lane_coalesce_noise_aa_pct`): ONE merged dispatch for all K
+    requests vs K per-request dispatches, median of paired interleaved
+    runs — dispatch amortization with the backend held fixed, the same
+    claim shape as `post_root_coalesce_speedup_pct`. The in-section
+    merged-rows assert pins K>1 requests per device call.
+
+    (c) BATCHED-VS-NATIVE, committed honestly
+    (`sender_lane_batched_vs_native_pct`): the merged device dispatch vs
+    the fused native batch over the SAME rows. On this box the XLA-CPU
+    proxy's 256-step ladder shares the host cores with (and runs far
+    below) the native C path, so the number is NEGATIVE — which is
+    precisely why THE offload gate (ops/sig_engine.py) keeps lone /
+    sub-floor traffic on the fused native batch, and the lone-request
+    gate is asserted structurally in-section (zero merged-dispatch
+    work). On a real TPU the device child recomputes it off-host.
+
+    (d) HIDDEN-FRACTION AUDIT (`sender_lane_hidden_pct`): the serving
+    shape — dispatch at decode time, join before execution — through a
+    real depth-2 scheduler, with each request running its witness
+    verification between dispatch and join. `sched.sig_wait` is the
+    recovery cost the request thread actually blocked on;
+    the `witness_engine.sig_*` phases are what recovery cost in total —
+    the hidden fraction is what the overlap removed from the critical
+    path (the proxy's "device" shares the host cores, so this audit —
+    not wall clock — is the honest committed claim)."""
+    import jax
+
+    from phant_tpu.backend import set_crypto_backend
+    from phant_tpu.ops.sig_engine import SigEngine
+    from phant_tpu.signer.signer import TxSigner
+    from phant_tpu.types.transaction import LegacyTx
+    from phant_tpu.utils.trace import metrics as _m
+
+    out: dict = {"sender_lane_backend": jax.devices()[0].platform}
+    if jax.default_backend() == "cpu":
+        os.environ["PHANT_ALLOW_JAX_CPU"] = "1"
+        out["sender_lane_proxy"] = "xla-cpu"
+    # proxy-sized defaults: the XLA-CPU ladder compiles ~1s and runs
+    # ~25ms per row-of-32 bucket on the 2-core box, so the merged shape
+    # stays in the 64-row bucket (raise K/T on a real accelerator —
+    # every request still sits BELOW the 64-row per-request floor, the
+    # serving regime the lane exists for)
+    K = int(os.environ.get("PHANT_BENCH_SIG_BATCH", "8"))
+    T = int(os.environ.get("PHANT_BENCH_SIG_TXS", "6"))
+    pairs = int(os.environ.get("PHANT_BENCH_SIG_PAIRS", "3"))
+
+    signer = TxSigner(1)
+
+    def _mk_txs(seed: int, n: int = T, pre155: bool = False, bad_at: int = -1):
+        txs = []
+        for i in range(n):
+            tx = LegacyTx(
+                nonce=i,
+                gas_price=10 + seed,
+                gas_limit=21_000,
+                to=bytes([0x7E]) * 20,
+                value=1 + seed + i,
+                data=b"",
+                v=27 if pre155 else 37,
+                r=0,
+                s=0,
+            )
+            tx = signer.sign(tx, 0xB00B + seed * 1009 + i)
+            if i == bad_at:
+                from dataclasses import replace
+
+                tx = replace(tx, v=99)  # unrecoverable: v inconsistent
+            txs.append(tx)
+        return txs
+
+    def requests_for(seed: int):
+        reqs = [_mk_txs(seed * K + i) for i in range(K)]
+        return reqs, [signer.signature_rows(t) for t in reqs]
+
+    # -- (a) identity incl. invalid-signature + pre-EIP-155 blocks -------
+    id_reqs = [_mk_txs(0), _mk_txs(1, pre155=True), _mk_txs(2, bad_at=3)]
+    id_rows = [signer.signature_rows(t) for t in id_reqs]
+    oracles = [
+        signer.recover_senders_async(t, force_cpu=True)() for t in id_reqs
+    ]
+    set_crypto_backend("tpu")
+    try:
+        eng = SigEngine(device_floor=0)
+        got = eng.sig_many(id_rows)
+        for g, want, txs in zip(got, oracles, id_reqs):
+            assert g == want, "merged senders diverged from the oracle"
+        assert got[2][3] is None, "invalid signature not attributed"
+        assert eng.stats["device_batches"] == 1
+        frag = {"sender_lane_identity_requests": len(id_reqs)}
+        out.update(frag)
+        _bank(out)
+
+        # -- (b)+(c): paired timing legs ---------------------------------
+        warm_reqs, warm_rows = requests_for(997)
+        eng.sig_many(warm_rows)  # merged-K compile
+        eng.sig_many([warm_rows[0]])  # single-request compile
+        out["sender_lane_requests"] = K
+        out["sender_lane_txs_per_request"] = T
+        assert eng.stats["sig_rows"] >= K * T + T
+        # the merged-dispatch counter claim: K>1 requests per device call
+        rows_per_dispatch = K * T
+        assert rows_per_dispatch > T
+        out["sender_lane_merged_rows_per_dispatch"] = rows_per_dispatch
+
+        def t_merged(seed: int) -> float:
+            _reqs, rows = requests_for(seed)
+            t0 = time.perf_counter()
+            eng.sig_many(rows)
+            return time.perf_counter() - t0
+
+        def t_singles(seed: int) -> float:
+            _reqs, rows = requests_for(seed)
+            t0 = time.perf_counter()
+            for r in rows:
+                eng.sig_many([r])
+            return time.perf_counter() - t0
+
+        def t_native(seed: int) -> float:
+            # rows PREBUILT outside the timer, exactly like the merged
+            # leg: both legs time recovery only, so vs_native isolates
+            # the backend and carries no row-build (signing-hash keccak)
+            # bias in the merged dispatch's favor
+            _reqs, rows = requests_for(seed)
+            t0 = time.perf_counter()
+            for r in rows:
+                signer.recover_rows_async(r, force_cpu=True)()
+            return time.perf_counter() - t0
+
+        # per-request dispatches must clear the floor too (backend held
+        # fixed — the coalescing claim isolates dispatch amortization)
+        coal, aa, vs_native = [], [], []
+        best_m, best_n = float("inf"), float("inf")
+        for rep in range(pairs):
+            nat = t_native(rep * 4)
+            s1 = t_singles(rep * 4 + 1)
+            m1 = t_merged(rep * 4 + 2)
+            m2 = t_merged(rep * 4 + 3)  # the A/A twin: box, not code
+            coal.append(s1 / m1 - 1)
+            aa.append(abs(1 - m2 / m1))
+            vs_native.append(nat / m1 - 1)
+            best_m, best_n = min(best_m, m1), min(best_n, nat)
+        coal.sort()
+        aa.sort()
+        vs_native.sort()
+        frag = {
+            "sender_lane_coalesce_speedup_pct": round(
+                coal[len(coal) // 2] * 100, 1
+            ),
+            "sender_lane_coalesce_noise_aa_pct": round(
+                aa[len(aa) // 2] * 100, 1
+            ),
+            "sender_lane_batched_vs_native_pct": round(
+                vs_native[len(vs_native) // 2] * 100, 1
+            ),
+            "sender_lane_merged_senders_per_sec": round(K * T / best_m, 1),
+            "sender_lane_native_senders_per_sec": round(K * T / best_n, 1),
+            "sender_lane_pairs": pairs,
+        }
+        out.update(frag)
+        _bank(frag)
+
+        # -- lone-request gate: native path, zero merged dispatches ------
+        # the production floor, pinned explicitly (test runs lower the
+        # PHANT_TPU_MIN_ECRECOVER env to 1): a lone sub-floor request
+        # lands on the fused native batch with zero merged-dispatch work
+        lone = SigEngine(device_floor=64)
+        lone_rows = signer.signature_rows(_mk_txs(553))
+        assert lone.sig_many([lone_rows])[0] == (
+            signer.recover_senders_async(_mk_txs(553), force_cpu=True)()
+        )
+        assert lone.stats["device_batches"] == 0, lone.stats
+        assert (
+            lone.stats["native_batches"] + lone.stats["scalar_batches"] == 1
+        )
+        frag = {"sender_lane_lone_gate_native": 1}
+        out.update(frag)
+        _bank(frag)
+
+        # -- (d) hidden-fraction audit through the REAL request path -----
+        # stateless.dispatch_sender_recovery against an installed depth-2
+        # scheduler: dispatch at decode time, the request's witness
+        # verification in between, the `sched.sig_wait`-timed join before
+        # execution — the serving code path itself, not a simulation
+        import threading
+
+        from phant_tpu import serving
+        from phant_tpu.ops.witness_engine import WitnessEngine
+        from phant_tpu.serving.scheduler import (
+            SchedulerConfig,
+            VerificationScheduler,
+        )
+        from phant_tpu.stateless import dispatch_sender_recovery
+
+        wit_root, wit_nodes = _sender_lane_witness()
+        woracle = WitnessEngine()
+        assert woracle.verify(wit_root, wit_nodes)
+        t_before = _m.snapshot()["timers"]
+
+        def _delta(t_after, name):
+            return t_after.get(name, {}).get("total_s", 0.0) - (
+                t_before.get(name, {}).get("total_s", 0.0)
+            )
+
+        sig_env_prev = os.environ.get("PHANT_BATCHED_SIG")
+        os.environ["PHANT_BATCHED_SIG"] = "1"
+        s = VerificationScheduler(
+            engine=WitnessEngine(),
+            config=SchedulerConfig(
+                max_batch=K,
+                max_wait_ms=50.0,
+                pipeline_depth=2,
+                sig_engine_factory=lambda: SigEngine(device_floor=0),
+            ),
+        )
+        serving.install(s)
+        try:
+            reqs, _rows = requests_for(771)
+            results = [None] * K
+
+            def one(i):
+                resolve = dispatch_sender_recovery(1, reqs[i])
+                assert resolve is not None, "sig lane not engaged"
+                assert s.verify_traced(wit_root, wit_nodes)[0]
+                results[i] = resolve()
+
+            threads = [
+                threading.Thread(target=one, args=(i,)) for i in range(K)
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(120)
+            st = s.stats_snapshot()
+        finally:
+            serving.uninstall(s)
+            s.shutdown()
+            if sig_env_prev is None:
+                os.environ.pop("PHANT_BATCHED_SIG", None)
+            else:
+                os.environ["PHANT_BATCHED_SIG"] = sig_env_prev
+        for i, got_s in enumerate(results):
+            want = signer.recover_senders_async(reqs[i], force_cpu=True)()
+            assert got_s == want, "sig-lane senders diverged under overlap"
+        assert st["sig_coalesced"] >= 2, st
+        t_after = _m.snapshot()["timers"]
+        # the hidden-fraction denominator is the ENGINE's recovery cost
+        # only — stateless.sig_rows runs on the request's own handler
+        # thread and is never hidden, so counting it would inflate the
+        # claim by exactly the on-critical-path row-build time
+        cost = sum(
+            _delta(t_after, f"witness_engine.sig_{ph}")
+            for ph in ("prefetch", "pack", "dispatch", "resolve")
+        )
+        wait = _delta(t_after, "sched.sig_wait")
+        frag = {
+            "sender_lane_hidden_pct": round(
+                max(0.0, 1.0 - wait / cost) * 100, 1
+            )
+            if cost > 0
+            else None,
+            "sender_lane_sched_coalesced": st["sig_coalesced"],
+        }
+        out.update(frag)
+        _bank(frag)
+    finally:
+        set_crypto_backend("cpu")
+    return out
+
+
+def _sender_lane_witness():
+    """A small witnessed account trie for the hidden-fraction audit's
+    per-request witness-verification leg."""
+    from phant_tpu.crypto.keccak import keccak256 as _k
+    from phant_tpu.mpt.mpt import Trie as _Trie
+    from phant_tpu.mpt.proof import generate_proof as _proof
+    from phant_tpu.state.root import account_leaf as _aleaf
+    from phant_tpu.types.account import Account as _Acct
+
+    trie = _Trie()
+    addrs = [bytes([1 + i]) * 20 for i in range(48)]
+    for i, a in enumerate(addrs):
+        trie.put(_k(a), _aleaf(_Acct(balance=i * 10**12 + 1)))
+    nodes: dict = {}
+    for a in addrs:
+        for enc in _proof(trie, _k(a)):
+            nodes[enc] = None
+    return trie.root_hash(), list(nodes)
+
+
 def sec_commitment_compare() -> dict:
     """Pluggable commitment schemes (phant_tpu/commitment/): the hexary
     MPT vs the binary Merkle backend on the SAME span.
@@ -2816,6 +3131,7 @@ _DEVICE_SECTIONS = {
     "engine_pipeline": sec_engine_pipeline,
     "witness_stream": sec_witness_stream,
     "post_root": sec_post_root,
+    "sender_lane": sec_sender_lane,
     "keccak": sec_keccak_device,
     "ecrecover": sec_ecrecover_device,
     "replay": sec_replay_device,
@@ -2828,6 +3144,7 @@ _DEVICE_BUDGET = {
     "engine_pipeline": 420,
     "witness_stream": 420,
     "post_root": 420,
+    "sender_lane": 420,
     "ecrecover": 900,
     "replay": 700,
     "state_root": 480,
@@ -2966,7 +3283,13 @@ def main() -> None:
     only = os.environ.get("PHANT_BENCH_ONLY", "")
     selected = [s.strip() for s in only.split(",") if s.strip()] or (
         list(_CPU_SECTIONS)
-        + ["witness_resident", "engine_pipeline", "witness_stream", "post_root"]
+        + [
+            "witness_resident",
+            "engine_pipeline",
+            "witness_stream",
+            "post_root",
+            "sender_lane",
+        ]
     )
     # legacy per-section kill switches stay honored
     for flag, sec in (
@@ -3122,6 +3445,7 @@ def main() -> None:
             "engine_pipeline",
             "witness_stream",
             "post_root",
+            "sender_lane",
             "replay",
             "keccak",
         ):

@@ -68,6 +68,14 @@ DEFAULT_ENTRIES: Tuple[str, ...] = (
     # readback is annotated)
     "phant_tpu.ops.root_engine.RootEngine.prefetch_batch",
     "phant_tpu.ops.root_engine.RootEngine.root_many",
+    # coalesced sender recovery (PR 14): the sig lane's merge (the row
+    # concat + limb encode the prefetch stage runs) and the sig_many
+    # dispatch path exist to enqueue the merged ecrecover with ZERO host
+    # sync — a reintroduced `.item()`/readback in the merge loop puts a
+    # blocking round trip back on every coalesced recovery (the resolve
+    # stage's honest sender readback is annotated)
+    "phant_tpu.ops.sig_engine.SigEngine.prefetch_batch",
+    "phant_tpu.ops.sig_engine.SigEngine.sig_many",
     # pluggable commitment schemes (PR 12): the binary backend's witness
     # pack loop (full-subtree node collection) and proof-path walk feed
     # the serving differential/bench spans and the fixture-translation

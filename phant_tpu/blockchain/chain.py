@@ -101,7 +101,10 @@ class Blockchain:
         derived exactly those roots from the same tx/withdrawal tuples one
         call earlier (the blockHash check covers header integrity there).
         `senders` optionally supplies prefetched sender addresses (None
-        entries = invalid signature) from the run_blocks pipeline."""
+        entries = invalid signature) — the run_blocks pipeline's window
+        prefetch, or the serving sig lane's merged cross-request
+        ecrecover (stateless.dispatch_sender_recovery ->
+        ops/sig_engine.py), both join the block here."""
         self.validate_block_header(block.header)
         if block.uncles:
             raise BlockError("post-merge blocks must have no uncles")
@@ -376,8 +379,13 @@ class Blockchain:
 
         # recover every sender up front — one fused batch (native, or device
         # when the tpu backend and batch size warrant it; reference recovers
-        # per-tx, blockchain.zig:241). run_blocks may hand in prefetched
-        # senders recovered on device while earlier blocks executed.
+        # per-tx, blockchain.zig:241). Prefetched senders arrive from two
+        # producers: run_blocks (device recovery windows ahead of the
+        # replay) and the serving sig lane (one merged ecrecover across
+        # concurrent requests, dispatched at decode time — ops/
+        # sig_engine.py). The None-entry error message below must stay
+        # byte-identical to get_senders_batch's SignatureError text: the
+        # lane's invalid-signature attribution contract rides on it.
         if senders is None:
             try:
                 senders = self.signer.get_senders_batch(list(block.transactions))

@@ -121,6 +121,16 @@ def _default_root_engine_factory(index: int):
     return RootEngine(device_index=index)
 
 
+def _default_sig_engine_factory(index: int):
+    """Per-device SIG engine (ops/sig_engine.py), pinned to mesh device
+    `index`: a sender-recovery batch routed to this lane runs its merged
+    ecrecover on the lane's own chip — the sig twin of the pinned
+    witness/root engines."""
+    from phant_tpu.ops.sig_engine import SigEngine
+
+    return SigEngine(device_index=index)
+
+
 def _abandon(engine, handle) -> None:
     """Best-effort lease release on a crash path — the scheduler's helper,
     imported lazily (scheduler.py is always loaded before a pool exists;
@@ -174,6 +184,7 @@ class MeshExecutorPool:
         engine: Optional[object] = None,
         engine_factory: Optional[Callable[[int], object]] = None,
         root_engine_factory: Optional[Callable[[int], object]] = None,
+        sig_engine_factory: Optional[Callable[[int], object]] = None,
         on_done: Callable = None,
         on_stage: Callable = None,
         on_skip: Callable = None,
@@ -211,6 +222,10 @@ class MeshExecutorPool:
         # only ever from its own lane thread — no lock needed
         self._root_factory = root_engine_factory or _default_root_engine_factory
         self._root_engines: List[Optional[object]] = [None] * self._n
+        # sig lane: one pinned SigEngine per device, same lazy lane-thread
+        # construction discipline as the root engines above
+        self._sig_factory = sig_engine_factory or _default_sig_engine_factory
+        self._sig_engines: List[Optional[object]] = [None] * self._n
         self._on_done = on_done or (lambda *a: None)
         self._on_stage = on_stage or (lambda *a: None)
         self._on_skip = on_skip or (lambda *a: None)
@@ -428,6 +443,14 @@ class MeshExecutorPool:
             eng = self._root_engines[i] = self._root_factory(i)
         return eng
 
+    def _sig_engine_for(self, i: int):
+        """The lane's pinned SigEngine, built lazily on its first sig
+        batch (only ever touched from lane thread `i`)."""
+        eng = self._sig_engines[i]
+        if eng is None:
+            eng = self._sig_engines[i] = self._sig_factory(i)
+        return eng
+
     def _run_executor(self, i: int) -> None:
         engine = self._engines[i]
         # immutable pipeline depth, read lock-free (write-once in __init__)
@@ -473,17 +496,25 @@ class MeshExecutorPool:
                     item["jobs"] = jobs
                     # lazy import like every scheduler symbol here (the
                     # package-cycle discipline, see _abandon)
-                    from phant_tpu.serving.scheduler import _ROOT
+                    from phant_tpu.serving.scheduler import _ROOT, _SIG
 
                     is_root = jobs[0].kind == _ROOT
-                    eng = self._root_engine_for(i) if is_root else engine
+                    is_sig = jobs[0].kind == _SIG
+                    if is_root:
+                        eng = self._root_engine_for(i)
+                    elif is_sig:
+                        eng = self._sig_engine_for(i)
+                    else:
+                        eng = engine
                     cur, stage = item, "pack"
-                    if two_phase or (is_root and depth_cap > 1):
+                    if two_phase or ((is_root or is_sig) and depth_cap > 1):
                         # the SAME payload list goes to prefetch and
                         # begin: plan identity is the engine's match check
-                        # (witness tuples / root HashPlans alike)
+                        # (witness tuples / root HashPlans / SigRows alike)
                         if is_root:
                             wits = [j.plan for j in jobs]
+                        elif is_sig:
+                            wits = [j.rows for j in jobs]
                         else:
                             wits = [(j.root, j.nodes) for j in jobs]
                         plan = None
@@ -535,6 +566,8 @@ class MeshExecutorPool:
                         self._on_stage(item["batch_id"], "dispatch", i)
                         if is_root:
                             verdicts, record = self._roots_inline(eng, item)
+                        elif is_sig:
+                            verdicts, record = self._sigs_inline(eng, item)
                         else:
                             verdicts, record = self._verify_inline(eng, item)
                         cur = None
@@ -618,34 +651,57 @@ class MeshExecutorPool:
         return verdicts, record
 
     @staticmethod
-    def _roots_inline(engine, item: dict):
-        """Depth-1 root-lane execution: one fused begin+resolve against
-        the lane's pinned RootEngine (the root_many shape)."""
-        from phant_tpu.serving.scheduler import root_record_from_handle
-
+    def _lane_inline(engine, item: dict, payload, record_builder):
+        """Depth-1 root/sig-lane execution: one fused begin+resolve
+        against the lane's pinned engine (the root_many/sig_many shape)
+        — one definition for both lanes; the callers supply the payload
+        list and the scheduler's record builder."""
         jobs = item["jobs"]
-        handle = engine.begin_batch([j.plan for j in jobs])
+        handle = engine.begin_batch(payload)
         results = engine.resolve_batch(handle)
-        record = root_record_from_handle(
+        record = record_builder(
             handle, item["batch_id"], len(jobs), jobs[0].bucket
         )
         record["stage"] = "dispatch"
         return results, record
 
+    def _roots_inline(self, engine, item: dict):
+        from phant_tpu.serving.scheduler import root_record_from_handle
+
+        return self._lane_inline(
+            engine,
+            item,
+            [j.plan for j in item["jobs"]],
+            root_record_from_handle,
+        )
+
+    def _sigs_inline(self, engine, item: dict):
+        from phant_tpu.serving.scheduler import sig_record_from_handle
+
+        return self._lane_inline(
+            engine,
+            item,
+            [j.rows for j in item["jobs"]],
+            sig_record_from_handle,
+        )
+
     @staticmethod
     def _record_from_handle(handle, item: dict) -> dict:
         from phant_tpu.serving.scheduler import (
             _ROOT,
+            _SIG,
             batch_record_from_handle,
             root_record_from_handle,
+            sig_record_from_handle,
         )
 
         jobs = item["jobs"]
-        builder = (
-            root_record_from_handle
-            if jobs and jobs[0].kind == _ROOT
-            else batch_record_from_handle
-        )
+        if jobs and jobs[0].kind == _ROOT:
+            builder = root_record_from_handle
+        elif jobs and jobs[0].kind == _SIG:
+            builder = sig_record_from_handle
+        else:
+            builder = batch_record_from_handle
         record = builder(handle, item["batch_id"], len(jobs), jobs[0].bucket)
         if "prefetch_ms" in item:
             record["prefetch_ms"] = item["prefetch_ms"]

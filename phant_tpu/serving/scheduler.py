@@ -319,6 +319,10 @@ class SchedulerConfig:
     # device floors); None = the process-shared ops/root_engine.py engine
     # (mesh lanes build one PINNED RootEngine per device instead)
     root_engine_factory: Optional[Callable] = None
+    # sig-lane engine injection (tests/bench: poisoned engines, forced
+    # device floors); None = the process-shared ops/sig_engine.py engine
+    # (mesh lanes build one PINNED SigEngine per device instead)
+    sig_engine_factory: Optional[Callable] = None
 
 
 _WITNESS = "witness"
@@ -331,6 +335,17 @@ _SERIAL = "serial"
 #: buckets are NEGATIVE ints (-(level count)) so they can never collide
 #: with the witness lane's pow2-byte buckets (>= 1).
 _ROOT = "root"
+#: sender-recovery lane (PR 14): jobs carry one request's signature rows
+#: (signer.TxSigner.signature_rows) and coalesce into ONE merged
+#: ops/sig_engine.py ecrecover dispatch — the same admission / fairness /
+#: assembly / pipeline / crash machinery as the witness and root lanes
+#: (the SigEngine speaks the WitnessEngine two-phase protocol). Rows are
+#: freely concatenable (no per-request shape constraint — the kernel
+#: pow2-pads the merged batch), so EVERY sig job shares one fixed bucket:
+#: a large negative sentinel far below any root bucket (-(level count),
+#: bounded by trie depth) and disjoint from witness pow2 buckets (>= 1).
+_SIG = "sig"
+_SIG_BUCKET = -(1 << 20)
 
 #: _next_batch(block=False) found nothing queued (distinct from None =
 #: closed/dead): the prefetching executor re-evaluates its pending work
@@ -446,6 +461,24 @@ def root_record_from_handle(
     }
 
 
+def sig_record_from_handle(
+    handle, batch_id: int, batch_size: int, bucket: int
+) -> dict:
+    """The sig-lane batch record: backend (merged device dispatch vs the
+    offload-gated fused native batch / scalar fallback) and the merged
+    row count come off the SigHandle. Shared by the resolve worker and
+    the mesh lanes, like the witness and root record builders above."""
+    return {
+        "batch_id": batch_id,
+        "batch_size": batch_size,
+        "bucket_bytes": bucket,
+        "stage": "resolve",
+        "lane": _SIG,
+        "backend": getattr(handle, "backend", None) or "native",
+        "merged_rows": getattr(handle, "n_rows", None),
+    }
+
+
 def _abandon_handle(engine, handle) -> None:
     """Release a dispatched-but-unresolved engine handle on a crash path.
     The shared engine outlives a dead scheduler; a leaked handle would
@@ -482,6 +515,8 @@ class _Job:
     bucket: int = 0
     # root lane: the request's fused post-root HashPlan
     plan: Optional[object] = None
+    # sig lane: the request's signature rows (signer.SigRows)
+    rows: Optional[object] = None
     # serial lane
     fn: Optional[Callable] = None
     # observability: the submitting request's trace context, and the batch
@@ -530,6 +565,9 @@ class VerificationScheduler:
         # root-lane engine, resolved lazily on the first root batch (the
         # shared ops/root_engine.py engine unless the config injects one)
         self._root_engine = None
+        # sig-lane engine, resolved lazily on the first sig batch (the
+        # shared ops/sig_engine.py engine unless the config injects one)
+        self._sig_engine = None
         # mesh dispatch: per-device executors behind the assembler. The
         # pool is built here (its engines are jax-free until the device
         # route engages) and the scheduler's own resolve worker is NOT —
@@ -553,6 +591,13 @@ class VerificationScheduler:
                 root_engine_factory=(
                     (lambda _i: self.config.root_engine_factory())
                     if self.config.root_engine_factory is not None
+                    else None
+                ),
+                # sig lane: same shape — injected factories are
+                # index-blind, the default pins one SigEngine per lane
+                sig_engine_factory=(
+                    (lambda _i: self.config.sig_engine_factory())
+                    if self.config.sig_engine_factory is not None
                     else None
                 ),
                 on_done=self._mesh_done,
@@ -637,6 +682,12 @@ class VerificationScheduler:
             "root_batches": 0,
             "root_requests": 0,
             "root_coalesced": 0,
+            # sender-recovery lane (PR 14): batches through
+            # ops/sig_engine.py and requests that shared a merged
+            # ecrecover dispatch
+            "sig_batches": 0,
+            "sig_requests": 0,
+            "sig_coalesced": 0,
         }
         metrics.gauge_set("sched.pipeline_depth", self._pipe_depth)
         self._thread = threading.Thread(
@@ -864,12 +915,126 @@ class VerificationScheduler:
                 self._root_engine = shared_root_engine()
         return self._root_engine
 
+    # -- sig lane (coalesced sender recovery, PR 14) --------------------------
+
+    def _sig_job(
+        self,
+        rows,
+        deadline_s: Optional[float],
+        tenant: Optional[str],
+        priority: Optional[int],
+    ) -> _Job:
+        # ONE fixed bucket for every sig job: signature rows concatenate
+        # freely (the merged batch pow2-pads inside the kernel), so all
+        # concurrent requests' rows coalesce — the whole point of the lane
+        return _Job(
+            kind=_SIG,
+            future=Future(),
+            admitted=time.monotonic(),
+            deadline=self._deadline(deadline_s),
+            tenant=tenant if tenant is not None else current_tenant(),
+            priority=priority if priority is not None else current_priority(),
+            rows=rows,
+            nbytes=rows.n,
+            bucket=_SIG_BUCKET,
+            trace_id=current_trace_id(),
+        )
+
+    def submit_sig(
+        self,
+        rows,
+        deadline_s: Optional[float] = None,
+        wait_for_space: bool = False,
+        tenant: Optional[str] = None,
+        priority: Optional[int] = None,
+    ) -> Future:
+        """Queue one request's signature rows (signer.SigRows, built by
+        `TxSigner.signature_rows`); the future resolves to the request's
+        sender list in tx order (None = invalid signature — the caller
+        owns the error attribution, chain.apply_body). Admission,
+        per-tenant QoS, deadlines, and overload shedding are the witness
+        lane's — same codes, same shed order."""
+        job = self._sig_job(rows, deadline_s, tenant, priority)
+        self._admit(job, wait_for_space)
+        return job.future
+
+    def sig_async(
+        self,
+        rows,
+        deadline_s: Optional[float] = None,
+        tenant: Optional[str] = None,
+        priority: Optional[int] = None,
+    ):
+        """Dispatch one request's sender recovery NOW and return
+        `resolve() -> (senders, batch record)` — the split face the
+        request path uses (stateless.dispatch_sender_recovery): recovery
+        dispatches at decode time and joins just before EVM execution,
+        so the merged ecrecover hides under witness verification."""
+        job = self._sig_job(rows, deadline_s, tenant, priority)
+        self._admit(job, False)
+
+        def resolve():
+            return job.future.result(), job.meta
+
+        return resolve
+
+    def sig_traced(
+        self,
+        rows,
+        deadline_s: Optional[float] = None,
+        tenant: Optional[str] = None,
+        priority: Optional[int] = None,
+    ) -> Tuple[List[Optional[bytes]], Optional[dict]]:
+        """One request's senders through the batching path, returning
+        (senders, batch record) — the sig twin of verify_traced/
+        root_traced; the record joins the caller's span to the merged
+        ecrecover dispatch that served it."""
+        return self.sig_async(rows, deadline_s, tenant, priority)()
+
+    def sig_many(self, rows_list: Sequence) -> List[List[Optional[bytes]]]:
+        """Sender slices for a span of requests' rows, pushed through the
+        SAME admission/assembly/executor path the server uses — the
+        offline face of the sig lane (bench, soak, tests). Blocks on
+        queue space and applies no deadline, like verify_many."""
+        if threading.current_thread() in (
+            self._thread,
+            self._resolve_thread,
+            self._prefetch_thread,
+        ):
+            raise RuntimeError(
+                "sig_many called from a scheduler thread (deadlock)"
+            )
+        futs = [
+            self.submit_sig(r, deadline_s=float("inf"), wait_for_space=True)
+            for r in rows_list
+        ]
+        return [f.result() for f in futs]
+
+    def accepts_sig(self) -> bool:
+        """Can the CURRENT thread route sender recovery through this
+        scheduler? The sig lane shares the witness lane's consumers and
+        lifecycle, so the answer is the same."""
+        return self.accepts_witness()
+
+    def _resolve_sig_engine(self):
+        if self._sig_engine is None:
+            if self.config.sig_engine_factory is not None:
+                self._sig_engine = self.config.sig_engine_factory()
+            else:
+                from phant_tpu.ops.sig_engine import shared_sig_engine
+
+                self._sig_engine = shared_sig_engine()
+        return self._sig_engine
+
     @staticmethod
     def _payload_of(jobs: List[_Job], kind: str) -> list:
         """The engine-facing batch payload: (root, nodes) tuples for the
-        witness lane, HashPlans for the root lane."""
+        witness lane, HashPlans for the root lane, SigRows for the sig
+        lane."""
         if kind == _ROOT:
             return [j.plan for j in jobs]
+        if kind == _SIG:
+            return [j.rows for j in jobs]
         return [(j.root, j.nodes) for j in jobs]
 
     def _deadline(self, deadline_s: Optional[float]) -> Optional[float]:
@@ -1355,7 +1520,7 @@ class VerificationScheduler:
             self._inflight_list.append(
                 {
                     "batch_id": batch_id,
-                    "lane": _WITNESS,
+                    "lane": kind,
                     "stage": "prefetch",
                     "device": None,
                     "started": now,
@@ -1371,7 +1536,7 @@ class VerificationScheduler:
         flight.record(
             "sched.batch_start",
             batch_id=batch_id,
-            lane=_WITNESS,
+            lane=kind,
             stage="prefetch",
             batch_size=len(batch),
             bucket_bytes=batch[0].bucket,
@@ -1436,9 +1601,9 @@ class VerificationScheduler:
                 "chaos drill: PHANT_SCHED_CHAOS_CRASH=1 induced executor crash"
             )
         kind = item.get("kind", _WITNESS)
-        if kind == _ROOT:
-            # root batches always have a two-phase engine; a fully-shed
-            # batch just releases the prefetch merge
+        if kind in (_ROOT, _SIG):
+            # root/sig batches always have a two-phase engine; a fully-
+            # shed batch just releases the prefetch merge
             if not jobs:
                 if plan is not None:
                     plan.release()
@@ -1448,13 +1613,15 @@ class VerificationScheduler:
             self._pipeline_handoff(
                 jobs,
                 batch_id,
-                self._resolve_root_engine(),
+                self._resolve_root_engine()
+                if kind == _ROOT
+                else self._resolve_sig_engine(),
                 item["picked"],
                 plan=plan,
                 prefetch_ms=item.get("prefetch_ms"),
                 plan_payload=item["payload"],
                 plan_njobs=len(item["jobs"]),
-                kind=_ROOT,
+                kind=kind,
             )
             return
         engine = self._resolve_engine()
@@ -1603,6 +1770,12 @@ class VerificationScheduler:
                     # merging the batch's HashPlans into the pooled
                     # staging blob (ops/root_engine.py prefetch_batch)
                     engine = self._resolve_root_engine()
+                elif item.get("kind") == _SIG:
+                    # sig lane: the 4th stage runs the ROW LOWERING —
+                    # concatenating the batch's signature rows and the
+                    # u256 -> limb encode (ops/sig_engine.py
+                    # prefetch_batch)
+                    engine = self._resolve_sig_engine()
                 else:
                     engine = self._resolve_engine()
                 pf = getattr(engine, "prefetch_batch", None)
@@ -1861,11 +2034,12 @@ class VerificationScheduler:
             self._exec_stage = stage
         else:
             self._exec_stage = "pack"  # provisional: engine resolution
-            engine = (
-                self._resolve_root_engine()
-                if lane == _ROOT
-                else self._resolve_engine()
-            )
+            if lane == _ROOT:
+                engine = self._resolve_root_engine()
+            elif lane == _SIG:
+                engine = self._resolve_sig_engine()
+            else:
+                engine = self._resolve_engine()
             pipelined = self._pipe_depth > 1 and hasattr(engine, "begin_batch")
             # stage vocabulary: pipelined batches move pack -> dispatch ->
             # resolve; a depth-1/inline batch runs all three fused under
@@ -1903,11 +2077,11 @@ class VerificationScheduler:
             # finishes the batch (or _die clears everything)
             self._execute_witness_pipelined(batch, batch_id, engine, now, kind=lane)
             return
-        if lane in (_WITNESS, _ROOT) and self._pool is not None:
+        if lane in (_WITNESS, _ROOT, _SIG) and self._pool is not None:
             # the descriptor stays in flight until the mesh lane finishes
             # the batch (_mesh_done/_mesh_skip) or _die clears everything
-            if lane == _ROOT:
-                self._execute_roots_mesh(batch, batch_id, now)
+            if lane in (_ROOT, _SIG):
+                self._execute_lane_mesh(batch, batch_id, now)
             else:
                 self._execute_witness_mesh(batch, batch_id, now)
             return
@@ -1916,6 +2090,8 @@ class VerificationScheduler:
                 self._execute_serial(batch[0], batch_id)
             elif lane == _ROOT:
                 self._execute_roots(batch, batch_id, engine, now)
+            elif lane == _SIG:
+                self._execute_sigs(batch, batch_id, engine, now)
             else:
                 self._execute_witness(batch, batch_id, engine, now)
         finally:
@@ -2056,25 +2232,35 @@ class VerificationScheduler:
         record["stage"] = "dispatch"  # fused begin+resolve, like depth-1
         self._finish_root_jobs(jobs, results, record, picked)
 
-    def _finish_root_jobs(
-        self, jobs: List[_Job], results, record: dict, picked: float
+    def _finish_plan_jobs(
+        self,
+        jobs: List[_Job],
+        results,
+        record: dict,
+        picked: float,
+        lane: str,
+        emit: Callable[[int], None],
     ) -> None:
-        """Root-lane completion tail: per-job meta + future resolution
-        (each future gets ITS plan's out digests), the batch_done record,
-        and the coalescing metrics/stats."""
+        """Shared completion tail of the root AND sig lanes: per-job meta
+        + future resolution (each future gets ITS request's result
+        slice), the batch_done record, and the coalescing metrics/stats
+        — one definition so the two lanes can never diverge (the
+        copy-divergence class this repo keeps eliminating). `emit(n)`
+        publishes the lane's own counters: metric names must stay string
+        LITERALS at their emit site (the METRICNAME contract), so each
+        lane wrapper passes a closure instead of a name."""
         n = len(jobs)
         done = time.monotonic()
         served: dict = {}
-        for j, digests in zip(jobs, results):
+        for j, result in zip(jobs, results):
             served[j.tenant] = served.get(j.tenant, 0) + 1
-            # meta BEFORE set_result (the verify_traced/root_traced
-            # ordering contract)
+            # meta BEFORE set_result (the *_traced ordering contract)
             j.meta = {
                 **record,
                 "tenant": j.tenant,
                 "queue_wait_ms": round((picked - j.admitted) * 1e3, 3),
             }
-            _safe_resolve(j.future, digests)
+            _safe_resolve(j.future, result)
         flight.record(
             "sched.batch_done",
             duration_ms=round((done - picked) * 1e3, 3),
@@ -2084,25 +2270,72 @@ class VerificationScheduler:
             **record,
         )
         metrics.observe_hist("sched.batch_size", n, buckets=_BATCH_BUCKETS)
-        metrics.count("sched.batches", lane="root")
-        metrics.count("sched.root_batches", backend=record.get("backend", "host"))
-        if n > 1:
-            metrics.count("sched.root_coalesced", n)
+        metrics.count("sched.batches", lane=lane)
+        emit(n)
         for tenant, cnt in served.items():
             metrics.count("sched.tenant_served", cnt, tenant=tenant)
         with self._lock:
             st = self.stats
             st["batches"] += 1
             st["batched_requests"] += n
-            st["root_batches"] += 1
-            st["root_requests"] += n
+            st[lane + "_batches"] += 1
+            st[lane + "_requests"] += n
             if n > 1:
-                st["root_coalesced"] += n
+                st[lane + "_coalesced"] += n
                 st["coalesced"] += n
             if n > st["max_batch_seen"]:
                 st["max_batch_seen"] = n
             for tenant, cnt in served.items():
                 self._tenant_locked(tenant)["served"] += cnt
+
+    def _finish_root_jobs(
+        self, jobs: List[_Job], results, record: dict, picked: float
+    ) -> None:
+        """Root-lane completion: each future gets ITS plan's out digests
+        (storage roots in patch order, post root last)."""
+
+        def emit(n: int) -> None:
+            metrics.count(
+                "sched.root_batches", backend=record.get("backend", "host")
+            )
+            if n > 1:
+                metrics.count("sched.root_coalesced", n)
+
+        self._finish_plan_jobs(jobs, results, record, picked, _ROOT, emit)
+
+    def _execute_sigs(
+        self, batch: List[_Job], batch_id: int, engine, picked: float
+    ) -> None:
+        """Depth-1/inline sig execution: one begin+resolve round trip on
+        the executor thread (the sig_many shape) — the coalesced batch
+        still merges into ONE dispatch; only the pipeline overlap is
+        absent."""
+        jobs = self._shed_or_keep(batch, picked)
+        if not jobs:
+            return
+        self._exec_stage = "dispatch"
+        handle = engine.begin_batch([j.rows for j in jobs])
+        results = engine.resolve_batch(handle)
+        record = sig_record_from_handle(
+            handle, batch_id, len(jobs), jobs[0].bucket
+        )
+        record["stage"] = "dispatch"  # fused begin+resolve, like depth-1
+        self._finish_sig_jobs(jobs, results, record, picked)
+
+    def _finish_sig_jobs(
+        self, jobs: List[_Job], results, record: dict, picked: float
+    ) -> None:
+        """Sig-lane completion: each future gets ITS request's sender
+        slice (tx order; None = invalid signature)."""
+
+        def emit(n: int) -> None:
+            metrics.count(
+                "sched.sig_batches", backend=record.get("backend", "native")
+            )
+            if n > 1:
+                metrics.count("sched.sig_coalesced", n)
+
+        self._finish_plan_jobs(jobs, results, record, picked, _SIG, emit)
 
     # -- mesh dispatch (mesh_devices >= 1, serving/mesh_exec.py) -------------
 
@@ -2175,15 +2408,16 @@ class VerificationScheduler:
                 if d["batch_id"] == batch_id:
                     d["device"] = device
 
-    def _execute_roots_mesh(
+    def _execute_lane_mesh(
         self, batch: List[_Job], batch_id: int, picked: float
     ) -> None:
-        """Fan one root batch out to the per-device pool: bucket-affinity
-        routing (a level shape keeps hitting the same lane's pinned
-        RootEngine, so its compiled program stays warm on that chip) with
-        the same spillover/backpressure as witness batches. Root batches
-        never take the megabatch path — there is no whole-mesh fused
-        root kernel; the lane's merged dispatch IS the fusion."""
+        """Fan one root or sig batch out to the per-device pool:
+        bucket-affinity routing (a level shape keeps hitting the same
+        lane's pinned RootEngine; every sig batch shares one bucket, so
+        one lane's pinned SigEngine keeps its compiled ecrecover shapes
+        warm, with spillover as the load balancer) with the same
+        backpressure as witness batches. Root/sig batches never take the
+        megabatch path — the lane's merged dispatch IS the fusion."""
         jobs = self._shed_or_keep(batch, picked)
         if not jobs:
             with self._lock:
@@ -2200,10 +2434,12 @@ class VerificationScheduler:
 
     def _mesh_done(self, jobs, verdicts, record, picked, batch_id) -> None:
         """Lane completion (pool thread): the shared completion tail —
-        witness or root by the jobs' kind — then the watchdog descriptor
-        drops."""
+        witness, root, or sig by the jobs' kind — then the watchdog
+        descriptor drops."""
         if jobs and jobs[0].kind == _ROOT:
             self._finish_root_jobs(jobs, verdicts, record, picked)
+        elif jobs and jobs[0].kind == _SIG:
+            self._finish_sig_jobs(jobs, verdicts, record, picked)
         else:
             self._finish_witness_jobs(jobs, verdicts, record, picked)
         with self._lock:
@@ -2336,6 +2572,12 @@ class VerificationScheduler:
                 handle, item["batch_id"], len(jobs), jobs[0].bucket
             )
             finish = self._finish_root_jobs
+        elif item.get("kind") == _SIG:
+            results = engine.resolve_batch(handle)
+            record = sig_record_from_handle(
+                handle, item["batch_id"], len(jobs), jobs[0].bucket
+            )
+            finish = self._finish_sig_jobs
         else:
             results = engine.resolve_batch(handle)
             record = batch_record_from_handle(

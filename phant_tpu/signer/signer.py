@@ -186,18 +186,75 @@ def recovery_fields(tx: Transaction, chain_id: int) -> Tuple[int, int, int]:
     return tx.r, tx.s, rec_id
 
 
-def _min_device_ecrecover() -> int:
-    import os
+def recover_rows_host(msgs, rs, ss, recids):
+    """The host recovery route over raw signature rows: ONE fused native
+    batch (recover + keccak + address in C, GIL released) when the
+    toolchain is present, the scalar pure-Python path otherwise. Returns
+    `(senders, backend)` with backend in ("native", "scalar"); None
+    entries = unrecoverable. THE one definition shared by
+    `TxSigner.recover_rows_async` and the serving sig engine's host
+    route (ops/sig_engine.py), so the fallback semantics can never
+    diverge from the oracle the lane is differential-tested against.
+    Placeholder (invalid-signature) rows recover to garbage here; the
+    caller's bad-mask discards them."""
+    from phant_tpu.utils.native import load_native
 
-    return int(os.environ.get("PHANT_TPU_MIN_ECRECOVER", "64"))
+    native = load_native()
+    if native is not None:
+        return native.ecrecover_batch(msgs, rs, ss, recids), "native"
+    out = []
+    for m, r, s, rid in zip(msgs, rs, ss, recids):
+        try:
+            pub = secp256k1.recover_pubkey(m, r, s, rid)
+            out.append(address_from_pubkey(pub))
+        except SignatureError:
+            out.append(None)
+    return out, "scalar"
+
+
+class SigRows:
+    """One transaction list's signature rows, built on the caller's own
+    thread: per-tx `(signing_hash, r, s, recid)` plus the set of indices
+    whose signatures failed static validation (`bad` — those rows carry a
+    well-formed placeholder lane and their results are discarded, the
+    `recover_senders_async` contract). This is the unit the serving sig
+    lane merges across requests (ops/sig_engine.py): rows are pure host
+    data, so K requests' rows concatenate into one device ecrecover
+    dispatch with no per-request shape constraints."""
+
+    __slots__ = ("msgs", "rs", "ss", "recids", "bad")
+
+    def __init__(self, msgs, rs, ss, recids, bad):
+        self.msgs = msgs
+        self.rs = rs
+        self.ss = ss
+        self.recids = recids
+        self.bad = bad  # frozenset of invalid-signature tx indices
+
+    @property
+    def n(self) -> int:
+        return len(self.msgs)
 
 
 class TxSigner:
     """Chain-id-aware sender recovery + test signing
-    (reference: src/signer/signer.zig:20-79)."""
+    (reference: src/signer/signer.zig:20-79).
 
-    def __init__(self, chain_id: int):
+    `min_device_ecrecover` is the device-route batch floor, resolved ONCE
+    at construction (env PHANT_TPU_MIN_ECRECOVER, default 64) — the r14
+    bugfix: the old module helper re-read `os.environ` on every
+    `recover_senders_async` call on the hot path. An explicit argument is
+    the test/engine override and wins over the env."""
+
+    def __init__(self, chain_id: int, min_device_ecrecover: Optional[int] = None):
         self.chain_id = chain_id
+        if min_device_ecrecover is None:
+            import os
+
+            min_device_ecrecover = int(
+                os.environ.get("PHANT_TPU_MIN_ECRECOVER", "64")
+            )
+        self._min_device = min_device_ecrecover
 
     def get_sender(self, tx: Transaction) -> bytes:
         r, s, rec_id = recovery_fields(tx, self.chain_id)
@@ -218,50 +275,14 @@ class TxSigner:
             raise SignatureError(f"unrecoverable signature at tx index {bad[0]}")
         return out
 
-    def recover_senders_async(self, txs, force_cpu: bool = False):
-        """Dispatch sender recovery and return `resolve() -> [address|None]`
-        (None = invalid signature; the error is raised by whoever consumes
-        the block, keeping prefetch failures attributed to the right block).
-
-        Backend selection: the device kernel only wins when the batch
-        amortizes transfer+dispatch latency, so batches below
-        PHANT_TPU_MIN_ECRECOVER (default 64) take the fused native batch
-        even on `--crypto_backend=tpu` — a single real block's ~8-200 txs
-        must never pay tunnel RTT serially (round-2 lesson: the flag made
-        replay 45x slower). Cross-block prefetch (chain.run_blocks)
-        concatenates many blocks' txs to clear the floor. `force_cpu`
-        pins this call to the CPU path WITHOUT touching the process-global
-        backend (the device-loss fallback must not race concurrent
-        requests)."""
-        from phant_tpu.backend import crypto_backend, jax_device_ok
-
-        if not txs:
-            return lambda: []
-        tpu_ok = (
-            not force_cpu and crypto_backend() == "tpu" and jax_device_ok()
-        )
-        use_tpu = tpu_ok and len(txs) >= _min_device_ecrecover()
-        native = None
-        if not use_tpu:
-            from phant_tpu.utils.native import load_native
-
-            native = load_native()
-            if native is None:
-                if tpu_ok:
-                    # no toolchain: the device kernel beats scalar Python
-                    # even below the floor (the floor only arbitrates
-                    # device vs the fused NATIVE batch)
-                    use_tpu = True
-                else:  # no toolchain, no device: scalar pure-Python path
-                    out = []
-                    for tx in txs:
-                        try:
-                            out.append(self.get_sender(tx))
-                        except SignatureError:
-                            out.append(None)
-                    return lambda: out
-
-        msgs, rs, ss, recids, bad = [], [], [], [], set()
+    def signature_rows(self, txs) -> SigRows:
+        """The per-tx signature rows `(signing_hash, r, s, recid)` for a
+        tx list — the host keccak-over-RLP work, shared by the local
+        `recover_senders_async` path and the serving sig lane
+        (ops/sig_engine.py), so the row semantics (invalid-signature
+        placeholder lane included) can never diverge between them."""
+        msgs, rs, ss, recids = [], [], [], []
+        bad = set()
         for i, tx in enumerate(txs):
             try:
                 r, s, rec_id = recovery_fields(tx, self.chain_id)
@@ -275,14 +296,65 @@ class TxSigner:
             rs.append(r)
             ss.append(s)
             recids.append(rec_id)
+        return SigRows(msgs, rs, ss, recids, frozenset(bad))
+
+    def recover_senders_async(self, txs, force_cpu: bool = False):
+        """Dispatch sender recovery and return `resolve() -> [address|None]`
+        (None = invalid signature; the error is raised by whoever consumes
+        the block, keeping prefetch failures attributed to the right block).
+
+        Backend selection: the device kernel only wins when the batch
+        amortizes transfer+dispatch latency, so batches below
+        PHANT_TPU_MIN_ECRECOVER (default 64) take the fused native batch
+        even on `--crypto_backend=tpu` — a single real block's ~8-200 txs
+        must never pay tunnel RTT serially (round-2 lesson: the flag made
+        replay 45x slower). Cross-block prefetch (chain.run_blocks)
+        concatenates many blocks' txs to clear the floor, and the serving
+        path's sig lane (ops/sig_engine.py — THE offload-gate story)
+        merges CONCURRENT requests' rows to clear it under Engine API
+        traffic where no single block can. `force_cpu`
+        pins this call to the CPU path WITHOUT touching the process-global
+        backend (the device-loss fallback must not race concurrent
+        requests)."""
+        if not txs:
+            return lambda: []
+        return self.recover_rows_async(
+            self.signature_rows(txs), force_cpu=force_cpu
+        )
+
+    def recover_rows_async(self, rows: SigRows, force_cpu: bool = False):
+        """`recover_senders_async` over PRE-BUILT signature rows — the
+        serving sig lane's degrade path reuses the rows it already built
+        instead of paying the signing-hash keccak pass twice
+        (stateless.dispatch_sender_recovery). Same backend selection,
+        same `resolve() -> [address|None]` contract."""
+        from phant_tpu.backend import crypto_backend, jax_device_ok
+
+        if rows.n == 0:
+            return lambda: []
+        tpu_ok = (
+            not force_cpu and crypto_backend() == "tpu" and jax_device_ok()
+        )
+        use_tpu = tpu_ok and rows.n >= self._min_device
+        if not use_tpu and tpu_ok:
+            from phant_tpu.utils.native import load_native
+
+            if load_native() is None:
+                # no toolchain: the device kernel beats scalar Python
+                # even below the floor (the floor only arbitrates
+                # device vs the fused NATIVE batch)
+                use_tpu = True
+
+        msgs, rs, ss, recids, bad = rows.msgs, rows.rs, rows.ss, rows.recids, rows.bad
 
         if use_tpu:
             from phant_tpu.ops.secp256k1_jax import ecrecover_batch_async
 
             inner = ecrecover_batch_async(msgs, rs, ss, recids)
         else:
-            # fused native batch: recover + keccak + address in one FFI call
-            done = native.ecrecover_batch(msgs, rs, ss, recids)
+            # the shared host route: fused native batch, or scalar when
+            # the toolchain is absent (recover_rows_host)
+            done, _backend = recover_rows_host(msgs, rs, ss, recids)
             inner = lambda: done  # noqa: E731
 
         def resolve():

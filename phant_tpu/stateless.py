@@ -836,6 +836,121 @@ def _batched_root_wanted() -> bool:
     return crypto_backend() == "tpu" and jax_device_ok()
 
 
+def _batched_sig_wanted() -> bool:
+    """Route sender recovery through the serving sig lane?
+    PHANT_BATCHED_SIG=0 pins the in-request fused native batch, =1 forces
+    the lane (tests / XLA-CPU proxy); auto engages it exactly when the
+    device route exists (tpu backend + live device) — on the pure-CPU
+    path the lane would only add scheduler latency around the SAME fused
+    native batch the request already runs. The per-dispatch native-vs-
+    device decision stays with ops/sig_engine.py (THE offload-gate
+    story, the merged PHANT_TPU_MIN_ECRECOVER floor): this is only the
+    cheap 'could a device ever be involved' pre-filter."""
+    import os
+
+    env = os.environ.get("PHANT_BATCHED_SIG", "auto")
+    if env in ("0", "off", ""):
+        return False
+    if env == "1":
+        return True
+    from phant_tpu.backend import crypto_backend, jax_device_ok
+
+    return crypto_backend() == "tpu" and jax_device_ok()
+
+
+import threading as _threading
+
+#: per-chain-id TxSigner memo for the request path: the signer resolves
+#: its PHANT_TPU_MIN_ECRECOVER floor ONCE at construction (the r14
+#: signer bugfix), so a per-request construction would put the env read
+#: right back on the serving hot path. dict get is GIL-atomic; the lock
+#: only serializes first construction.
+_sig_signers: dict = {}
+_sig_signers_lock = _threading.Lock()
+
+
+def _request_signer(chain_id: int):
+    signer = _sig_signers.get(chain_id)
+    if signer is None:
+        from phant_tpu.signer.signer import TxSigner
+
+        with _sig_signers_lock:
+            signer = _sig_signers.setdefault(chain_id, TxSigner(chain_id))
+    return signer
+
+
+def dispatch_sender_recovery(chain_id: int, txs):
+    """Dispatch one block's sender recovery through the active
+    scheduler's sig lane; returns `resolve() -> senders`, or None when
+    the lane is not in play (no scheduler, `_batched_sig_wanted()`
+    false, empty tx list).
+
+    The request path calls this at DECODE time and joins just before EVM
+    execution (`apply_body`'s `senders=` prefetch parameter is the join
+    point), so the merged device ecrecover computes while this thread
+    verifies the witness and builds the node db. The signature rows —
+    host keccak over RLP, `TxSigner.signature_rows` — are built on THIS
+    handler thread (embarrassingly parallel across requests); invalid
+    signatures ride the placeholder lane and surface as None senders,
+    which `apply_body` raises with the exact per-index message the
+    inline `get_senders_batch` path raises (attribution parity is
+    differential-tested). A scheduler rejection — overload shed,
+    deadline, executor death, at dispatch OR join — degrades to the
+    fused native batch over the rows ALREADY built (no second
+    signing-hash pass) instead of failing the block: sender recovery
+    has a correct local fallback, so the lane may only ever help.
+
+    The resolve-side block time is exported as `sched.sig_wait` — the
+    part of the recovery that did NOT hide under witness verification
+    (the overlap audit, same reading as `sched.prefetch_wait`)."""
+    if not txs or not _batched_sig_wanted():
+        return None
+    from phant_tpu.serving import active_scheduler
+    from phant_tpu.serving.scheduler import SchedulerError
+
+    sched = active_scheduler()
+    if sched is None or not sched.accepts_sig():
+        return None
+    import time as _time
+
+    from phant_tpu.utils.trace import metrics
+
+    signer = _request_signer(chain_id)
+    with metrics.phase("stateless.sig_rows"):
+        rows = signer.signature_rows(list(txs))
+
+    def degrade():
+        # shed/crashed lane: recover from the rows ALREADY built (no
+        # second signing-hash pass) on the fused native batch —
+        # force_cpu because a -32052 may mean the device itself died
+        return signer.recover_rows_async(rows, force_cpu=True)()
+
+    try:
+        inner = sched.sig_async(rows)
+    except SchedulerError:
+        return degrade  # shed at admission
+
+    def resolve():
+        t0 = _time.perf_counter()
+        try:
+            senders, meta = inner()
+        except SchedulerError:
+            return degrade()
+        finally:
+            metrics.observe("sched.sig_wait", _time.perf_counter() - t0)
+        if meta is not None:
+            from phant_tpu.utils.trace import current_span
+
+            sp = current_span()
+            if sp is not None:
+                # sig_-prefixed: the open verify_block span already
+                # carries the WITNESS batch record under the bare keys
+                sp.attrs.update({f"sig_{k}": v for k, v in meta.items()})
+        return senders
+
+    return resolve
+
+
 def compute_post_root(state: WitnessStateDB) -> bytes:
     """The request path's post-state root.
 
@@ -892,9 +1007,8 @@ def compute_post_root(state: WitnessStateDB) -> bytes:
 # ---------------------------------------------------------------------------
 # witness verification entry (the TPU-batched hot loop)
 # ---------------------------------------------------------------------------
-
-
-import threading as _threading
+# (`_threading` is the module-level alias imported above, at the sig-
+# signer memo)
 
 _witness_engine = None
 _witness_engine_lock = _threading.Lock()
@@ -1014,6 +1128,17 @@ def execute_stateless(
         codes=len(codes),
     ):
         try:
+            # sender recovery dispatches FIRST (the sig lane,
+            # ops/sig_engine.py): the merged device ecrecover computes
+            # while THIS thread verifies the witness and decodes the
+            # node db, and joins just before EVM execution below —
+            # apply_body's `senders=` prefetch parameter is the join
+            # point, so ecrecover latency hides under witness
+            # verification + warm-set prefill. None = no lane in play:
+            # apply_body runs today's in-request fused batch.
+            resolve_senders = dispatch_sender_recovery(
+                chain_id, block.transactions
+            )
             with metrics.phase("stateless.witness_verify"):
                 witness_ok = verify_witness_nodes(pre_state_root, nodes)
             if not witness_ok:
@@ -1044,7 +1169,13 @@ def execute_stateless(
                     chain_id, state, parent_header, fork=fork, verify_state_root=False
                 )
             with metrics.phase("stateless.execute"):
-                result = chain.run_block(block)
+                # join the sig lane: senders recovered while the phases
+                # above ran (None entries = invalid signatures, raised
+                # by apply_body with the inline path's exact message)
+                senders = (
+                    resolve_senders() if resolve_senders is not None else None
+                )
+                result = chain.run_block(block, senders=senders)
             with metrics.phase("stateless.post_root"):
                 # batched through the serving root lane when a device is
                 # in reach (ops/root_engine.py); host walk otherwise

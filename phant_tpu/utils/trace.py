@@ -147,6 +147,7 @@ METRIC_HELP: Dict[str, str] = {
     "stateless.execute": "Block execution phase over the witness-backed state",
     "stateless.post_root": "Post-state-root recompute phase over the partial trie (host walk or the batched root lane)",
     "stateless.post_root_plan": "Fused account+storage hash-plan build on the request thread (WitnessStateDB.post_root_plan) before root-lane submission",
+    "stateless.sig_rows": "Signature-row build on the request thread (TxSigner.signature_rows — host keccak over RLP) before sig-lane submission",
     # memoized witness engine
     "witness_engine.interned_nodes": "Unique trie nodes currently interned in the witness engine",
     "witness_engine.interned_digests": "Unique 32-byte digests currently interned (nodes + child refs)",
@@ -178,6 +179,16 @@ METRIC_HELP: Dict[str, str] = {
     "witness_engine.root_requests": "Requests whose post root was computed through the root engine",
     "witness_engine.root_plan_hits": "Root prefetch merges consumed by begin_batch (identity-matched plans list)",
     "witness_engine.root_plan_stale": "Root prefetch merges dropped stale at begin time (shed changed the batch) — a perf miss, never a correctness event",
+    # coalesced sender recovery (ops/sig_engine.py)
+    "witness_engine.sig_prefetch": "Sig-lane prefetch stage: merging a batch's signature rows + the u256 -> limb encode OFF the serving critical path (SigEngine.prefetch_batch)",
+    "witness_engine.sig_pack": "Sig-lane pack stage: offload-gate routing + row merge (or prefetch-merge consumption) (SigEngine.begin_batch)",
+    "witness_engine.sig_dispatch": "Sig-lane dispatch stage: merged ecrecover kernel enqueue, no host sync",
+    "witness_engine.sig_resolve": "Sig-lane resolve stage: sender-address readback (device) or the fused native batch / scalar fallback over the same merged rows",
+    "witness_engine.sig_batches": "Sig batches executed, by backend (device = merged ecrecover dispatch; native/scalar = the offload-gated host routes)",
+    "witness_engine.sig_requests": "Requests whose senders were recovered through the sig engine",
+    "witness_engine.sig_rows": "Signature rows recovered through the sig engine (the merged-dispatch row counter: rows per batch >> rows per request under coalescing)",
+    "witness_engine.sig_plan_hits": "Sig prefetch merges consumed by begin_batch (identity-matched rows list)",
+    "witness_engine.sig_plan_stale": "Sig prefetch merges dropped stale at begin time (shed changed the batch) — a perf miss, never a correctness event",
     # device-resident intern table (ops/witness_resident.py)
     "witness_resident.rows": "Rows resident on device (digest + child-ref rows, persistent across batches)",
     "witness_resident.uploaded_nodes": "Truly-novel nodes uploaded to the resident table (after the host prune)",
@@ -208,6 +219,10 @@ METRIC_HELP: Dict[str, str] = {
     # root lane (batched post-state roots, serving/scheduler.py)
     "sched.root_batches": "Root-lane batches executed by the scheduler, by backend (device/host per the offload gate)",
     "sched.root_coalesced": "Root-lane requests that shared a coalesced root dispatch with at least one other request",
+    # sig lane (coalesced sender recovery, serving/scheduler.py)
+    "sched.sig_batches": "Sig-lane batches executed by the scheduler, by backend (device/native/scalar per the offload gate)",
+    "sched.sig_coalesced": "Sig-lane requests that shared a merged ecrecover dispatch with at least one other request",
+    "sched.sig_wait": "Request thread blocks joining its sig-lane senders at execute time — recovery cost that did NOT hide under witness verification (the overlap audit against the witness_engine.sig_* phases)",
     # mesh-sharded dispatch (phant_tpu/serving/mesh_exec.py)
     "sched.mesh_devices": "Device lanes in the mesh executor pool (--sched-mesh)",
     "sched.device_queue_depth": "Witness batches queued on a mesh device lane, by device",

@@ -101,15 +101,21 @@ def _direction(key: str) -> Optional[str]:
         # depth-tiered eviction, and the fraction of prefetch decode +
         # pre-scan time hidden under dispatch/resolve — both shrinking
         # means the streaming-ingestion win is regressing (the overlap
-        # speedup itself trend-gates via the _per_sec keys above)
+        # speedup itself trend-gates via the _per_sec keys above).
+        # sender_lane (round 14) rides the same _hidden_pct rule: the
+        # fraction of sig-lane recovery that hid under witness
+        # verification (`sched.sig_wait` vs the engine sig phases).
         return "up"
     if key.endswith("_speedup_pct"):
-        # post_root (round 11): the batched-vs-host median paired speedup
-        # — shrinking means the coalesced root dispatch is regressing
-        # toward the host walk. The section's A/A noise bar
-        # (`_noise_aa_pct`) and the lone-request parity echo
-        # (`_parity_pct`, asserted in-section against its own noise bar)
-        # fall through to informational.
+        # post_root (round 11) + sender_lane (round 14): the median
+        # paired COALESCING speedup — one merged dispatch vs K
+        # per-request dispatches, backend held fixed — shrinking means
+        # the coalesced dispatch is regressing toward per-request cost.
+        # Each section's A/A noise bar (`_noise_aa_pct`), the honest
+        # cross-backend echoes (`_vs_host_pct` / `_vs_native_pct`,
+        # NEGATIVE on the shared-core proxy by construction — the
+        # measured case for the offload gates), and the parity echoes
+        # (`_parity_pct`) fall through to informational.
         return "up"
     if key.endswith("_savings_vs_mpt_pct"):
         # commitment_compare (round 12): the binary backend's witness-byte

@@ -177,6 +177,9 @@ def main() -> int:
     rc = _post_root_phase()
     if rc:
         return rc
+    rc = _sender_lane_phase()
+    if rc:
+        return rc
     rc = _commitment_phase()
     if rc:
         return rc
@@ -710,6 +713,130 @@ def _post_root_phase() -> int:
     print(
         "[soak] post-root phase green: depth-2 batched roots byte-identical, "
         "induced root-dispatch crash fails only in-flight with a "
+        "stage-named dump"
+    )
+    return 0
+
+
+def _sender_lane_phase() -> int:
+    """Coalesced sender recovery soak (PR 14): the same request set
+    through the scheduler's sig lane at pipeline depth 2 on the
+    forced-device (XLA-CPU proxy) route must be byte-identical to the
+    `recover_senders_async(force_cpu=True)` oracle — invalid-signature
+    and pre-EIP-155 blocks included — and an induced SIG-DISPATCH crash
+    must fail only in-flight requests with -32052 while leaving a
+    stage-named flight dump."""
+    import json
+
+    from phant_tpu.backend import set_crypto_backend
+    from phant_tpu.ops.sig_engine import SigEngine
+    from phant_tpu.serving import (
+        SchedulerConfig,
+        SchedulerDown,
+        VerificationScheduler,
+    )
+    from phant_tpu.utils.jaxcache import enable_compile_cache
+
+    from test_sender_lane import _request_set
+
+    enable_compile_cache()  # warm from the pytest groups' persistent cache
+    failures: list = []
+    os.environ["PHANT_ALLOW_JAX_CPU"] = "1"
+    set_crypto_backend("tpu")
+    try:
+        oracles, rows_list = _request_set()
+        with VerificationScheduler(
+            config=SchedulerConfig(
+                max_batch=8,
+                max_wait_ms=10.0,
+                pipeline_depth=2,
+                sig_engine_factory=lambda: SigEngine(device_floor=0),
+            ),
+        ) as s:
+            outs = s.sig_many(rows_list)
+            st = s.stats_snapshot()
+        for got, want in zip(outs, oracles):
+            if got != want:
+                failures.append("sig-lane senders diverged from the oracle")
+        if st["sig_batches"] < 1:
+            failures.append(f"sig lane never batched: {st}")
+    finally:
+        set_crypto_backend("cpu")
+
+    class _PoisonedSig(SigEngine):
+        armed = False
+
+        def begin_batch(self, rows_list, prefetch=None):
+            if _PoisonedSig.armed:
+                raise RuntimeError("soak-induced sig dispatch crash")
+            return super().begin_batch(rows_list, prefetch=prefetch)
+
+    flight_dir = os.environ.get(
+        "PHANT_FLIGHT_DIR",
+        os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            "build",
+            "flight",
+        ),
+    )
+    os.makedirs(flight_dir, exist_ok=True)
+    before = set(os.listdir(flight_dir))
+    _PoisonedSig.armed = False
+    oracles, rows_list = _request_set()
+    s = VerificationScheduler(
+        config=SchedulerConfig(
+            max_batch=8,
+            max_wait_ms=5.0,
+            pipeline_depth=2,
+            sig_engine_factory=_PoisonedSig,
+        ),
+    )
+    try:
+        first = [s.submit_sig(r) for r in rows_list[:2]]
+        pre = [f.result(timeout=60) for f in first]
+        _PoisonedSig.armed = True
+        second = [s.submit_sig(r) for r in rows_list[2:]]
+        for f in second:
+            try:
+                f.result(timeout=60)
+                failures.append("in-flight sig job survived the dispatch crash")
+            except SchedulerDown as e:
+                if e.code != -32052:
+                    failures.append(f"wrong down code (sig): {e.code}")
+        if [f.result(timeout=1) for f in first] != pre:
+            failures.append("already-resolved senders lost after crash")
+    finally:
+        s.shutdown()
+    new_dumps = sorted(set(os.listdir(flight_dir)) - before)
+    crash_dumps = [d for d in new_dumps if "executor_crash" in d]
+    if not crash_dumps:
+        failures.append(f"no sig-crash flight dump ({new_dumps})")
+    else:
+        with open(os.path.join(flight_dir, crash_dumps[-1])) as f:
+            dump = json.load(f)
+        crashes = [
+            r
+            for r in dump.get("records", [])
+            if r.get("kind") == "sched.executor_crash"
+        ]
+        if not crashes or crashes[-1].get("stage") not in (
+            "pack",
+            "dispatch",
+            "prefetch",
+        ):
+            failures.append(
+                f"sig-crash dump does not name a dispatch-side stage: "
+                f"{crashes[-1] if crashes else None}"
+            )
+
+    if failures:
+        for f in failures:
+            print(f"[soak] FAIL (sender-lane phase): {f}", file=sys.stderr)
+        return 1
+    print(
+        "[soak] sender-lane phase green: depth-2 merged senders "
+        "byte-identical (invalid-sig + pre-EIP-155 blocks included), "
+        "induced sig-dispatch crash fails only in-flight with a "
         "stage-named dump"
     )
     return 0

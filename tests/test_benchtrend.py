@@ -465,3 +465,33 @@ def test_commitment_savings_collapse_flags(tmp_path):
     assert any(
         "commitment_binary_witness_savings_vs_mpt_pct" in f for f in flags
     )
+
+
+def test_sender_lane_key_directions():
+    """Round-14 `sender_lane` section keys: the coalescing speedup
+    (`_speedup_pct`) and the hidden-fraction audit (`_hidden_pct`) gate
+    UP, the merged/native sender rates trend via `_per_sec`, and the A/A
+    noise bar, the honest batched-vs-native proxy echo (NEGATIVE on the
+    shared-core box — the measured case for the merged offload gate),
+    and the shape echoes stay informational. Pinned so a suffix rework
+    cannot un-gate the PR 14 claim."""
+    d = benchtrend._direction
+    assert d("sender_lane_coalesce_speedup_pct") == "up"
+    assert d("sender_lane_hidden_pct") == "up"
+    assert d("sender_lane_merged_senders_per_sec") == "up"
+    assert d("sender_lane_native_senders_per_sec") == "up"
+    assert d("sender_lane_coalesce_noise_aa_pct") is None
+    assert d("sender_lane_batched_vs_native_pct") is None
+    assert d("sender_lane_merged_rows_per_dispatch") is None
+    assert d("sender_lane_requests") is None
+
+
+def test_sender_lane_speedup_regression_flags(tmp_path):
+    """A collapsed sig-lane coalescing speedup must flag: per-request
+    ecrecover dispatches creeping back onto the serving path show
+    exactly this signature."""
+    for n, s in enumerate([330.0, 345.0, 338.0], start=1):
+        _write_round(tmp_path, n, {"sender_lane_coalesce_speedup_pct": s})
+    _write_round(tmp_path, 4, {"sender_lane_coalesce_speedup_pct": 15.0})
+    rows, flags = benchtrend.analyze(str(tmp_path), 0.4, 2)
+    assert any("sender_lane_coalesce_speedup_pct" in f for f in flags)

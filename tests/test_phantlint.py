@@ -1036,3 +1036,31 @@ def test_binary_commitment_pack_loop_is_in_hostsync_scope(
     ]
     assert hits, [f.render() for f in res.new]
     assert any("commitment" in f.path for f in hits)
+
+
+def test_sig_engine_is_in_hostsync_scope(mutated_tree, monkeypatch):
+    """The sig lane's hot path (PR 14) is HOSTSYNC-scoped: the merge the
+    prefetch stage runs and the sig_many dispatch path are in
+    DEFAULT_ENTRIES, and a stray `.item()` reintroduced into the merge
+    loop turns the gate red (the resolve stage's honest sender readback
+    stays annotated)."""
+    from phant_tpu.analysis.rules.hostsync import DEFAULT_ENTRIES
+
+    assert (
+        "phant_tpu.ops.sig_engine.SigEngine.prefetch_batch" in DEFAULT_ENTRIES
+    )
+    assert "phant_tpu.ops.sig_engine.SigEngine.sig_many" in DEFAULT_ENTRIES
+    p = mutated_tree / "phant_tpu" / "ops" / "sig_engine.py"
+    src = p.read_text()
+    mutated = src.replace(
+        "        par = np.array(pars + [0] * pad, np.uint32)\n",
+        "        par = np.array(pars + [0] * pad, np.uint32)\n"
+        "        _n = par.sum().item()\n",
+        1,
+    )
+    assert mutated != src
+    p.write_text(mutated)
+    res = _analyze_repo_tree(mutated_tree, monkeypatch)
+    hits = [f for f in res.new if f.rule == "HOSTSYNC" and ".item()" in f.message]
+    assert hits, [f.render() for f in res.new]
+    assert any("sig_engine" in f.path for f in hits)
