@@ -97,6 +97,25 @@ def build_parser() -> argparse.ArgumentParser:
         help="Capture a JAX/XLA device trace of the serving process into "
         "this directory (view with TensorBoard or Perfetto)",
     )
+    p.add_argument(
+        "--slo-budget-ms",
+        type=float,
+        default=None,
+        help="Wall-clock SLO budget per verify_block request: a request "
+        "past it is captured as a full span tree into the /debug/slow "
+        "exemplar ring (obs/critpath.py; per-phase overrides via "
+        "PHANT_SLO_BUDGET_MS_<PHASE>). 0 disables capture. "
+        "Default: PHANT_SLO_BUDGET_MS or 0",
+    )
+    p.add_argument(
+        "--profile-dir",
+        type=str,
+        default=None,
+        help="Directory for on-demand profiler captures "
+        "(POST /debug/profile?seconds=T — single-flight, window capped "
+        "by PHANT_PROFILE_MAX_S). Default: PHANT_PROFILE_DIR or "
+        "build/profile",
+    )
     # continuous-batching scheduler (phant_tpu/serving/): the knobs of the
     # admission-queue -> batch-assembler -> executor pipeline
     p.add_argument(
@@ -314,6 +333,15 @@ def main(argv=None) -> int:
         import os
 
         os.environ["PHANT_HTTP_TIMEOUT_S"] = str(args.http_timeout_s)
+    if args.slo_budget_ms is not None or args.profile_dir is not None:
+        # observability knobs ride the env (the server re-resolves the
+        # memoized attribution config at construction)
+        import os
+
+        if args.slo_budget_ms is not None:
+            os.environ["PHANT_SLO_BUDGET_MS"] = str(args.slo_budget_ms)
+        if args.profile_dir is not None:
+            os.environ["PHANT_PROFILE_DIR"] = args.profile_dir
     sched_config = SchedulerConfig(**sched_kwargs)
     server = EngineAPIServer(
         chain,

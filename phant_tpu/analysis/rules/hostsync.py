@@ -76,6 +76,15 @@ DEFAULT_ENTRIES: Tuple[str, ...] = (
     # stage's honest sender readback is annotated)
     "phant_tpu.ops.sig_engine.SigEngine.prefetch_batch",
     "phant_tpu.ops.sig_engine.SigEngine.sig_many",
+    # critical-path attribution (PR 15): the busy-time integration points
+    # in the lane loops — begin_batch's handoff (busy begin) and the
+    # resolve worker (busy end) — are pure host arithmetic by design; a
+    # reintroduced `.item()`/readback there would put a device sync on
+    # EVERY pipelined batch under the banner of observability (the mesh
+    # lane loop, _run_executor above, already covers its own busy
+    # brackets)
+    "phant_tpu.serving.scheduler.VerificationScheduler._pipeline_handoff",
+    "phant_tpu.serving.scheduler.VerificationScheduler._resolve_run",
     # pluggable commitment schemes (PR 12): the binary backend's witness
     # pack loop (full-subtree node collection) and proof-path walk feed
     # the serving differential/bench spans and the fixture-translation

@@ -33,12 +33,17 @@ Request execution goes through the continuous-batching scheduler
   thread; the stall is counted in `engine_api.client_disconnects`.
 
 Observability surface: `GET /metrics` serves the process metrics registry
-as Prometheus text exposition, `GET /healthz` a JSON liveness probe that
-includes the scheduler state (queue depth, executor liveness) and turns
-503 when the executor has died; `GET /debug/flight` serves the obs flight
-recorder's ring (recent spans / errors / scheduler transitions) live, and
-the first `/healthz` flip to 503 auto-dumps the same ring to
-`build/flight/` (phant_tpu/obs/). Every POST runs inside its own trace
+as Prometheus text exposition (histogram families additionally carry
+derived bucket-interpolated p50/p99 gauges), `GET /healthz` a JSON
+liveness probe that includes the scheduler state (queue depth, executor
+liveness, per-lane `device_busy_pct`) and turns 503 when the executor has
+died; `GET /debug/flight` serves the obs flight recorder's ring (recent
+spans / errors / scheduler transitions) live, `GET /debug/slow` the
+SLO-exemplar ring (obs/critpath.py — full span trees of requests that
+blew `--slo-budget-ms`), `POST /debug/profile?seconds=T` grabs an
+on-demand, single-flight-guarded `jax_profile` capture into
+`--profile-dir` (obs/profiler.py), and the first `/healthz` flip to 503
+auto-dumps the flight ring to `build/flight/` (phant_tpu/obs/). Every POST runs inside its own trace
 context — the `trace_id` rides the scheduler jobs and span records the
 request creates, and is echoed back in the `X-Phant-Trace` response
 header — and is counted, latency-histogrammed, and gauge-tracked in
@@ -56,7 +61,7 @@ import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from phant_tpu.engine_api import handle_request
-from phant_tpu.obs import flight
+from phant_tpu.obs import critpath, flight, profiler
 from phant_tpu.serving import (
     PRIORITY_BACKFILL,
     PRIORITY_HEAD,
@@ -71,7 +76,12 @@ from phant_tpu.serving import (
     tenant_context,
     uninstall,
 )
-from phant_tpu.utils.trace import current_trace_id, metrics, trace_context
+from phant_tpu.utils.trace import (
+    REQUEST_SECONDS_BUCKETS,
+    current_trace_id,
+    metrics,
+    trace_context,
+)
 
 log = logging.getLogger("phant_tpu.engine_api")
 
@@ -219,6 +229,13 @@ class _ObservableHandler(BaseHTTPRequestHandler):
     def do_GET(self) -> None:  # noqa: N802 (stdlib API)
         path = self.path.split("?", 1)[0]
         if path == "/metrics":
+            # re-integrate the device-busy windows to NOW before
+            # rendering: the gauges otherwise move only on batch
+            # transitions, and a metrics-only scraper would read an idle
+            # lane frozen at its last mid-traffic value forever
+            sched = active_scheduler()
+            if sched is not None:
+                sched.refresh_busy_gauges()
             self._reply_raw(
                 200,
                 metrics.prometheus_text().encode(),
@@ -239,8 +256,68 @@ class _ObservableHandler(BaseHTTPRequestHandler):
                 ).encode(),
                 "application/json",
             )
+        elif path == "/debug/slow":
+            # SLO-busting exemplars (obs/critpath.py): full span trees +
+            # critical-path breakdowns of every request that blew
+            # --slo-budget-ms (or a per-phase env budget) — the metric
+            # says THAT it was slow, this ring says WHY
+            self._reply_raw(
+                200,
+                json.dumps(
+                    {
+                        "capacity": critpath.slow.capacity,
+                        "budget_ms": critpath.budget_ms(),
+                        "records": critpath.slow.records(),
+                    },
+                    default=str,
+                ).encode(),
+                "application/json",
+            )
         else:
             self._reply(404, {"error": "not found"})
+
+    def do_POST(self) -> None:  # noqa: N802 (stdlib API)
+        # the standalone metrics server accepts only the debug POSTs; the
+        # Engine API handler overrides do_POST and routes /debug/* here
+        self._do_debug_post()
+
+    def _do_debug_post(self) -> None:
+        """POST /debug/profile?seconds=T — on-demand profiler capture
+        (obs/profiler.py): single-flight (503 on overlap), hard-capped
+        window, artifacts on disk before the 200 lands."""
+        # drain any request body FIRST: these are keep-alive (HTTP/1.1)
+        # connections, and unread body bytes would desync the next
+        # request on the same socket into a garbage request line
+        length = int(self.headers.get("Content-Length", 0) or 0)
+        if length:
+            try:
+                self.rfile.read(length)
+            except TimeoutError:
+                metrics.count("engine_api.client_disconnects")
+                self.close_connection = True
+                return
+        path, _, query = self.path.partition("?")
+        if path != "/debug/profile":
+            self._reply(404, {"error": "not found"})
+            return
+        params = dict(
+            p.split("=", 1) for p in query.split("&") if "=" in p
+        )
+        try:
+            seconds = float(params.get("seconds", "5"))
+        except ValueError:
+            seconds = float("nan")
+        try:
+            out = profiler.capture(seconds)
+        except ValueError as e:
+            self._reply(400, {"error": str(e)})
+        except profiler.ProfileBusy as e:
+            # one trace per process: overlap is operator error, shed it
+            self._reply(503, {"error": str(e)})
+        except profiler.ProfileError as e:
+            self._reply(500, {"error": str(e)})
+        else:
+            self._reply(200, out)
 
     def _reply(self, status: int, payload: dict) -> None:
         self._reply_raw(status, json.dumps(payload).encode(), "application/json")
@@ -296,6 +373,12 @@ class EngineAPIServer:
         sched_config: SchedulerConfig = None,
     ):
         self.blockchain = blockchain
+        # re-resolve the attribution layer's memoized config NOW: the CLI
+        # writes --slo-budget-ms / --profile-dir into the env before
+        # constructing the server, and tests monkeypatch the same keys
+        # (obs/critpath.py documents why the config is not re-read per
+        # request)
+        critpath.refresh_from_env()
         self._owns_scheduler = scheduler is None
         if scheduler is None:
             scheduler = VerificationScheduler(config=sched_config)
@@ -307,6 +390,11 @@ class EngineAPIServer:
 
         class Handler(_ObservableHandler):
             def do_POST(self) -> None:  # noqa: N802 (stdlib API)
+                if self.path.split("?", 1)[0].startswith("/debug/"):
+                    # debug surface (profiler capture): not a JSON-RPC
+                    # request — skip the Engine API accounting so the
+                    # front-door latency histogram measures only traffic
+                    return self._do_debug_post()
                 t0 = time.perf_counter()
                 # Lock-discipline audit (phantlint LOCK, PR 2): the
                 # counter / in-flight gauge / latency-histogram updates
@@ -344,8 +432,16 @@ class EngineAPIServer:
                         self._handle_post()
                 finally:
                     metrics.gauge_add("engine_api.inflight", -1)
+                    # the front-door latency histogram rides THE shared
+                    # bucket table (trace.REQUEST_SECONDS_BUCKETS): buckets
+                    # freeze at first observation, so a second call site
+                    # with its own tuple would silently split the family —
+                    # and the derived p50/p99 gauges (prometheus_text)
+                    # need the overload tail the shared table carries
                     metrics.observe_hist(
-                        "engine_api.request_seconds", time.perf_counter() - t0
+                        "engine_api.request_seconds",
+                        time.perf_counter() - t0,
+                        buckets=REQUEST_SECONDS_BUCKETS,
                     )
 
             def _handle_post(self) -> None:

@@ -22,19 +22,40 @@ died. This package is that layer:
 * **Watchdog** (`watchdog.py`): detects the executor stalling inside a
   batch (deadline overrun without a crash) and records it as a metric +
   flight event.
+* **Critical-path attribution** (`critpath.py`, PR 15): a second span
+  sink tiles every `verify_block` request's wall clock into the
+  `critpath.*` phase family (queue wait / prefetch / pack / dispatch /
+  resolve / sig_wait / EVM / post-root ...), gauges the unattributed
+  residual (the honesty check), and captures SLO-busting requests as
+  full span trees into a dedicated ring (`GET /debug/slow`).
+* **Device-busy accounting** (`busy.py`, PR 15): per-lane
+  union-of-intervals busy integration over the two-phase begin/resolve
+  brackets — `sched.device_busy_pct{device=}` in /metrics and /healthz.
+* **On-demand profiler** (`profiler.py`, PR 15): `POST /debug/profile`
+  grabs a single-flight-guarded, hard-capped `jax_profile` window from a
+  live server.
 
-Importing this package registers the flight recorder as a span sink, so
-any module that touches obs gets span mirroring for free; the registration
-is idempotent.
+Importing this package registers the flight recorder and the critpath
+rollup as span sinks, so any module that touches obs gets span mirroring
+and attribution for free; the registrations are idempotent.
 """
 
 from __future__ import annotations
 
+from phant_tpu.obs import critpath
+from phant_tpu.obs.busy import BusyAccountant
 from phant_tpu.obs.flight import FlightRecorder, flight
 from phant_tpu.obs.watchdog import Watchdog
 from phant_tpu.utils.trace import add_span_sink
 
-__all__ = ["FlightRecorder", "Watchdog", "flight", "record_span"]
+__all__ = [
+    "BusyAccountant",
+    "FlightRecorder",
+    "Watchdog",
+    "critpath",
+    "flight",
+    "record_span",
+]
 
 
 def record_span(record: dict) -> None:
@@ -43,3 +64,4 @@ def record_span(record: dict) -> None:
 
 
 add_span_sink(record_span)
+add_span_sink(critpath.rollup)

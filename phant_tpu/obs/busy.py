@@ -1,0 +1,147 @@
+"""Per-lane device-busy accounting (PR 15).
+
+"How busy is each chip?" is the first question a real-hardware run asks,
+and until now nothing answered it: the scheduler counts batches and the
+watchdog flags stalls, but no gauge said "this lane's device computed 37%
+of the last half minute". The two-phase begin/resolve protocol already
+brackets device occupancy — begin_batch enqueues the device work with no
+host sync, resolve_batch pays the readback — so each lane (the
+single-executor scheduler, every MeshExecutorPool lane, and the root/sig
+engine lanes riding the same executors) integrates the UNION of its
+in-flight [begin, resolve] intervals here and exports it as
+`sched.device_busy_pct{device=}`.
+
+Union-of-intervals matters: with pipeline depth >= 2 a lane can hold two
+dispatched batches at once, and summing their durations would read > 100%
+busy. `BusyAccountant` keeps an open-interval count and accrues busy time
+whenever it is nonzero — overlap cannot double-count, and gaps between
+batches honestly read idle.
+
+The window is ROLLING (two half-window buckets, default 30s each; the
+carried bucket is capped at one window so a long eventless stretch can
+never pin the gauge to a stale average): a gauge integrated since
+process start would never move again after the first hour, while an
+operator asking "is the chip idle at depth 1" wants the recent past.
+Reads (`pct()` — both the /healthz surface and the /metrics scrape path
+via VerificationScheduler.refresh_busy_gauges) advance the same
+integration, so an idle lane decays toward 0 without traffic.
+
+Honesty caveat (documented in README): the bracket covers
+dispatch-enqueue through resolve-return, which includes the resolve
+stage's host-side readback/commit work — on a real accelerator that is a
+small tail; on the XLA-CPU proxy (whose "device" shares the host cores)
+the gauge reads host+device occupancy of the lane, not chip utilization.
+
+Thread-safety: one small lock per accountant; begin/end/pct are O(1)
+arithmetic, cheap enough for the per-batch serving path. Gauge publishes
+go through the metrics registry's own lock (never nested under ours).
+`enabled=False` (the PHANT_OBS_ATTRIBUTION=0 switch, read at
+scheduler/pool construction via obs.critpath.enabled()) makes every
+method a no-op — the off leg of the obs_overhead bench A/B.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Optional
+
+from phant_tpu.utils.trace import metrics
+
+#: default rolling half-window (seconds); two buckets => the gauge always
+#: reflects the last 30..60s of lane activity
+DEFAULT_WINDOW_S = 30.0
+
+
+class BusyAccountant:
+    """Union-of-intervals busy-time integrator for one device lane."""
+
+    def __init__(
+        self,
+        device: str,
+        window_s: float = DEFAULT_WINDOW_S,
+        enabled: bool = True,
+        publish: bool = True,
+        clock: Optional[Callable[[], float]] = None,
+    ):
+        self.device = str(device)
+        self.enabled = enabled
+        self._publish = publish
+        self._window_s = max(window_s, 1e-3)
+        self._clock = clock or time.monotonic
+        self._lock = threading.Lock()
+        now = self._clock()
+        self._open = 0  # in-flight [begin, resolve] intervals
+        self._last = now  # last integration timestamp
+        self._win_start = now
+        self._busy_cur = 0.0  # busy seconds in the current bucket
+        self._busy_prev = 0.0  # busy seconds in the previous bucket
+        self._prev_span = 0.0  # previous bucket's width (0 until one closes)
+        if enabled and publish:
+            # publish 0.0 at construction so every lane is PRESENT in
+            # /metrics from boot — an operator must be able to tell "lane
+            # 3 is idle" from "lane 3 never reported"
+            metrics.gauge_set("sched.device_busy_pct", 0.0, device=self.device)
+
+    # -- integration ---------------------------------------------------------
+
+    def _advance_locked(self, now: float) -> None:
+        dt = now - self._last
+        if dt > 0:
+            if self._open > 0:
+                self._busy_cur += dt
+            self._last = now
+        span = now - self._win_start
+        if span >= self._window_s:
+            # the carried bucket is CAPPED at one window (busy scaled
+            # proportionally): after a long idle or eventless stretch the
+            # elapsed bucket can span minutes, and carrying it whole
+            # would pin the gauge near the stale average for a full
+            # window — the contract is "the last 30..60s", not "since
+            # the last event"
+            carry = min(span, self._window_s)
+            self._busy_prev = self._busy_cur * (carry / span)
+            self._prev_span = carry
+            self._busy_cur = 0.0
+            self._win_start = now
+
+    def begin(self) -> None:
+        """A batch's device work was enqueued (begin_batch returned)."""
+        if not self.enabled:
+            return
+        with self._lock:
+            self._advance_locked(self._clock())
+            self._open += 1
+
+    def end(self) -> None:
+        """A batch resolved (or its handle was abandoned on a crash path —
+        the interval closes either way; extra end() calls clamp at 0)."""
+        if not self.enabled:
+            return
+        with self._lock:
+            self._advance_locked(self._clock())
+            if self._open > 0:
+                self._open -= 1
+            pct = self._pct_locked()
+        if self._publish:
+            metrics.gauge_set("sched.device_busy_pct", pct, device=self.device)
+
+    def _pct_locked(self) -> float:
+        span = self._prev_span + (self._last - self._win_start)
+        if span <= 0:
+            return 0.0
+        busy = self._busy_prev + self._busy_cur
+        return round(min(100.0, 100.0 * busy / span), 2)
+
+    def pct(self) -> float:
+        """The rolling busy percentage, integrated to NOW (reads advance
+        the window, so an idle lane decays without traffic); republishes
+        the gauge so /metrics and /healthz agree."""
+        if not self.enabled:
+            return 0.0
+        with self._lock:
+            self._advance_locked(self._clock())
+            pct = self._pct_locked()
+        if self._publish:
+            metrics.gauge_set("sched.device_busy_pct", pct, device=self.device)
+        return pct

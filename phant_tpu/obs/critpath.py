@@ -1,0 +1,336 @@
+"""Per-request critical-path latency attribution (PR 15).
+
+The serving stack emits rich counters and spans (PRs 1/4), but nothing
+reconstructs where a REQUEST's wall clock actually went: the
+`verify_block` span carries its phase timers and the batch records the
+serving lanes attach, yet "queue wait vs dispatch vs resolve vs EVM" had
+to be eyeballed per trace line. This module closes that gap with a span
+sink that, at every top-level `verify_block` close, TILES the request's
+wall clock into an exclusive phase breakdown:
+
+  sig_rows       signature-row build on the handler thread
+  queue_wait     admission -> executor pickup (witness batch record)
+  prefetch       waiting on the 4th-stage decode/pre-scan plan
+  pack           begin_batch's lock-held scan (the batch's pack_ms)
+  dispatch       dispatched-and-in-flight: begin_batch return -> resolve
+                 start — the window the device (or the pipeline ahead of
+                 this batch) owns the request
+  resolve        readback + commit + linkage join (the batch's resolve_ms)
+  witness_decode witness -> WitnessStateDB materialization
+  sig_wait       the sig-lane join block before EVM execution
+  evm            block execution minus the sig join
+  root_plan      fused post-root hash-plan build on the handler thread
+  root_wait      root-lane queue wait (root batch record)
+  post_root      the rest of the post-root phase: merged dispatch +
+                 readback + apply, or the host walk
+
+The tiling is HIERARCHICAL and clipped: batch-record stage timings are
+clipped into the request-side phase that contains them (a stage number
+can never claim more than the request actually waited), and each level's
+remainder goes to the enclosing catch-all (`dispatch` inside
+witness_verify, `evm` inside execute, `post_root` inside the post-root
+phase) — so the sub-tilings sum EXACTLY to their parent phases and the
+only unattributed residual is real: span overhead and gaps between
+phases. That residual is the honesty check: `critpath.unattributed_pct`
+(and the coverage twin) gauge the cumulative attributed share, and the
+test suite asserts >= 95% on the serving path at pipeline depths 1 AND 2
+across all three engine lanes. Everything lands in the
+`critpath.phase_seconds{phase=}` histogram family, which the derived
+p50/p99 gauges (utils/trace.py prometheus_text) turn into per-phase
+quantiles at scrape time.
+
+SLO exemplars: metrics tell you THAT requests are slow; the exemplar
+shows WHY. A request whose wall clock exceeds `--slo-budget-ms`
+(PHANT_SLO_BUDGET_MS; 0/unset = off) — or whose single phase exceeds a
+per-phase override (PHANT_SLO_BUDGET_MS_<PHASE>, e.g.
+PHANT_SLO_BUDGET_MS_QUEUE_WAIT) — is captured as its FULL span tree plus
+the breakdown into a dedicated bounded flight ring, served at
+`GET /debug/slow` and counted in `obs.slow_captures{trigger=}`.
+
+Config is resolved ONCE from the environment and memoized (the env-read-
+per-request pattern is exactly what the PR 14 signer bugfix removed from
+the hot path); `refresh_from_env()` re-reads it (the Engine API server
+calls it at construction, after the CLI has written its flags into the
+env), and `configure()` overrides it directly (tests, the bench A/B).
+`PHANT_OBS_ATTRIBUTION=0` disables the whole layer — the off leg of the
+`obs_overhead` bench section.
+
+Thread-safety: the rollup runs on request threads; the cumulative
+coverage totals sit under one small lock, the metrics registry and the
+slow ring carry their own. The sink must never fail the traced work —
+span() already swallows sink exceptions, and the rollup additionally
+treats malformed records as zero-valued.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Dict, Optional, Tuple
+
+from phant_tpu.obs.flight import FlightRecorder
+from phant_tpu.utils.trace import metrics
+
+#: the closed phase vocabulary (documented above + in METRIC_HELP):
+#: `critpath.phase_seconds{phase=}` only ever carries these labels, so
+#: the family's cardinality is bounded by construction
+PHASES: Tuple[str, ...] = (
+    "sig_rows",
+    "queue_wait",
+    "prefetch",
+    "pack",
+    "dispatch",
+    "resolve",
+    "witness_decode",
+    "sig_wait",
+    "evm",
+    "root_plan",
+    "root_wait",
+    "post_root",
+)
+
+#: the dedicated slow-exemplar ring (served at GET /debug/slow): its own
+#: recorder so a burst of slow requests cannot wash the main flight ring's
+#: scheduler postmortem context away — and vice versa
+slow = FlightRecorder(
+    capacity=int(os.environ.get("PHANT_SLOW_CAPACITY", "64"))
+)
+
+
+class _Config:
+    __slots__ = ("enabled", "budget_ms", "phase_budgets_ms")
+
+    def __init__(
+        self,
+        enabled: bool,
+        budget_ms: float,
+        phase_budgets_ms: Dict[str, float],
+    ):
+        self.enabled = enabled
+        self.budget_ms = budget_ms
+        self.phase_budgets_ms = phase_budgets_ms
+
+
+def _config_from_env() -> _Config:
+    try:
+        budget = float(os.environ.get("PHANT_SLO_BUDGET_MS", "0") or "0")
+    except ValueError:
+        budget = 0.0
+    phase_budgets: Dict[str, float] = {}
+    for ph in PHASES:
+        raw = os.environ.get(f"PHANT_SLO_BUDGET_MS_{ph.upper()}")
+        if not raw:
+            continue
+        try:
+            v = float(raw)
+        except ValueError:
+            continue
+        if v > 0:
+            phase_budgets[ph] = v
+    return _Config(
+        enabled=os.environ.get("PHANT_OBS_ATTRIBUTION", "1") not in ("0", ""),
+        budget_ms=budget,
+        phase_budgets_ms=phase_budgets,
+    )
+
+
+_cfg: _Config = _config_from_env()
+_cfg_lock = threading.Lock()
+
+
+def refresh_from_env() -> None:
+    """Re-resolve the memoized config from the environment (the Engine API
+    server calls this at construction so `--slo-budget-ms`/env changes
+    made before boot take effect; tests call it after monkeypatching)."""
+    global _cfg
+    with _cfg_lock:
+        _cfg = _config_from_env()
+
+
+def configure(
+    enabled: Optional[bool] = None,
+    budget_ms: Optional[float] = None,
+    phase_budgets_ms: Optional[Dict[str, float]] = None,
+) -> None:
+    """Override the memoized config directly (tests, the bench A/B legs);
+    None leaves a field as-is."""
+    global _cfg
+    with _cfg_lock:
+        _cfg = _Config(
+            enabled=_cfg.enabled if enabled is None else enabled,
+            budget_ms=_cfg.budget_ms if budget_ms is None else budget_ms,
+            phase_budgets_ms=(
+                dict(_cfg.phase_budgets_ms)
+                if phase_budgets_ms is None
+                else dict(phase_budgets_ms)
+            ),
+        )
+
+
+def enabled() -> bool:
+    """Is the attribution layer on? Read at scheduler/pool construction to
+    gate the busy accountants (obs/busy.py) with the same switch."""
+    return _cfg.enabled
+
+
+def budget_ms() -> float:
+    """The wall-clock SLO budget (0 = exemplar capture off)."""
+    return _cfg.budget_ms
+
+
+# cumulative coverage totals (the honesty gauges' numerator/denominator);
+# guarded by one small lock — two floats, nothing more
+_tot_lock = threading.Lock()
+_tot_wall_s = 0.0
+_tot_attr_s = 0.0
+
+
+def totals() -> Tuple[float, float]:
+    """(wall_s, attributed_s) cumulative since process start / last reset —
+    the bench section and tests compute coverage over a window from the
+    delta of two calls."""
+    with _tot_lock:
+        return _tot_wall_s, _tot_attr_s
+
+
+def reset_totals() -> None:
+    global _tot_wall_s, _tot_attr_s
+    with _tot_lock:
+        _tot_wall_s = 0.0
+        _tot_attr_s = 0.0
+
+
+def _num(v) -> Optional[float]:
+    return float(v) if isinstance(v, (int, float)) and v == v else None
+
+
+def attribute(record: dict) -> Tuple[Dict[str, float], float, float]:
+    """(breakdown_ms, unattributed_ms, wall_ms) for one top-level
+    `verify_block` span record. Pure function of the record — the
+    unit-testable core of the rollup.
+
+    Tiling rules (see the module docstring for the phase meanings):
+    every batch-record stage timing is clipped into the remaining width
+    of the request-side phase that contains it, in pipeline order, and
+    the remainder goes to that level's catch-all — so the sub-tilings
+    sum exactly to their parent phases and attributed time can never
+    exceed the phases the request actually measured."""
+    wall = _num(record.get("duration_ms")) or 0.0
+    phases = record.get("phases") or {}
+
+    def ph(name: str) -> float:
+        st = phases.get(name)
+        if isinstance(st, dict):
+            return _num(st.get("total_ms")) or 0.0
+        return 0.0
+
+    out: Dict[str, float] = {}
+
+    def put(name: str, v: float) -> None:
+        if v > 0.0:
+            out[name] = out.get(name, 0.0) + v
+
+    # handler-thread phases, already exclusive by construction
+    put("sig_rows", ph("stateless.sig_rows"))
+    put("witness_decode", ph("stateless.witness_decode"))
+
+    # witness_verify sub-tiling: queue_wait/prefetch/pack/resolve come
+    # from the witness batch record (bare keys — the sig/root lanes
+    # prefix theirs), each clipped to what is left of the phase; the
+    # remainder is `dispatch`, the dispatched-and-in-flight window
+    wv = ph("stateless.witness_verify")
+    rem = wv
+    for label, key in (
+        ("queue_wait", "queue_wait_ms"),
+        ("prefetch", "prefetch_ms"),
+        ("pack", "pack_ms"),
+        ("resolve", "resolve_ms"),
+    ):
+        v = _num(record.get(key))
+        if v is not None and v > 0.0:
+            v = min(v, rem)
+            put(label, v)
+            rem -= v
+    put("dispatch", rem)
+
+    # execute sub-tiling: the sig-lane join block, then EVM proper
+    ex = ph("stateless.execute")
+    sw = min(ph("sched.sig_wait"), ex)
+    put("sig_wait", sw)
+    put("evm", ex - sw)
+
+    # post-root sub-tiling: plan build (its own nested phase), the
+    # root-lane queue wait (prefixed record key), remainder = the merged
+    # dispatch + readback + apply, or the host walk
+    pr = ph("stateless.post_root")
+    rp = min(ph("stateless.post_root_plan"), pr)
+    rw = _num(record.get("root_queue_wait_ms")) or 0.0
+    rw = min(max(rw, 0.0), pr - rp)
+    put("root_plan", rp)
+    put("root_wait", rw)
+    put("post_root", pr - rp - rw)
+
+    attributed = sum(out.values())
+    unattributed = max(0.0, wall - attributed)
+    return out, unattributed, wall
+
+
+def _capture_slow(
+    record: dict,
+    breakdown: Dict[str, float],
+    wall_ms: float,
+    trigger: str,
+    budget: float,
+    over_ms: float,
+) -> None:
+    slow.record(
+        "obs.slow_capture",
+        trigger=trigger,
+        budget_ms=budget,
+        wall_ms=wall_ms,
+        over_ms=round(over_ms, 3),
+        breakdown_ms={k: round(v, 3) for k, v in breakdown.items()},
+        span=record,
+        trace_id=record.get("trace_id"),
+    )
+    metrics.count("obs.slow_captures", trigger=trigger)
+
+
+def rollup(record: dict) -> None:
+    """THE span sink (registered by phant_tpu/obs/__init__.py): roll a
+    top-level `verify_block` record into the critpath family, update the
+    coverage gauges, and capture an SLO exemplar when a budget blew."""
+    if record.get("span") != "verify_block":
+        return
+    cfg = _cfg
+    if not cfg.enabled:
+        return
+    breakdown, unattributed, wall = attribute(record)
+    if wall <= 0.0:
+        return
+    for label, v in breakdown.items():
+        metrics.observe_hist("critpath.phase_seconds", v / 1e3, phase=label)
+    metrics.observe_hist("critpath.wall_seconds", wall / 1e3)
+    metrics.observe_hist("critpath.unattributed_seconds", unattributed / 1e3)
+    metrics.count("critpath.requests")
+    global _tot_wall_s, _tot_attr_s
+    with _tot_lock:
+        _tot_wall_s += wall / 1e3
+        # clipped tiling means attributed <= wall by construction; min()
+        # keeps a malformed record from ever claiming > 100% coverage
+        _tot_attr_s += min(wall - unattributed, wall) / 1e3
+        cov = 100.0 * _tot_attr_s / _tot_wall_s if _tot_wall_s > 0 else 0.0
+    metrics.gauge_set("critpath.coverage_pct", round(cov, 2))
+    metrics.gauge_set("critpath.unattributed_pct", round(100.0 - cov, 2))
+    # SLO exemplars: wall budget first (the headline trigger), then the
+    # per-phase overrides — ONE capture per request, first trigger wins
+    if cfg.budget_ms > 0 and wall > cfg.budget_ms:
+        _capture_slow(
+            record, breakdown, wall, "wall", cfg.budget_ms, wall - cfg.budget_ms
+        )
+        return
+    for label, limit in cfg.phase_budgets_ms.items():
+        v = breakdown.get(label, 0.0)
+        if v > limit:
+            _capture_slow(record, breakdown, wall, label, limit, v - limit)
+            return

@@ -147,6 +147,8 @@ from typing import Callable, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from phant_tpu.obs import critpath
+from phant_tpu.obs.busy import BusyAccountant
 from phant_tpu.obs.flight import flight
 from phant_tpu.obs.watchdog import Watchdog
 from phant_tpu.serving.qos import (
@@ -618,6 +620,15 @@ class VerificationScheduler:
         # worker computes raises instead — the fire drill for the
         # 4th-stage crash path (stage-named record, -32052 fail-fast)
         self._chaos_prefetch = chaos == "prefetch"
+        # per-lane device-busy accounting (obs/busy.py): the single
+        # executor drives ONE device ("0" — lane 0's chip in mesh terms);
+        # with a mesh pool the LANES bracket their own devices instead.
+        # Gated by the same switch as the critpath rollup
+        # (PHANT_OBS_ATTRIBUTION, read once here) so the obs_overhead
+        # bench A/B flips the whole attribution layer together.
+        self._busy_acct = BusyAccountant(
+            "0", enabled=critpath.enabled() and self._pool is None
+        )
         self._lock = threading.Lock()
         self._cond = threading.Condition(self._lock)
         # admission state (guarded by _lock): the serial mutation lane is
@@ -1333,6 +1344,18 @@ class VerificationScheduler:
             "prefetch": self._prefetch_on
             or bool(mesh is not None and mesh.get("prefetch")),
             "prefetch_pending": prefetch_pending,
+            # per-lane device-busy (obs/busy.py): "the chip idles 60% at
+            # depth 1" read straight off the probe. Reads integrate to
+            # now, so idle lanes decay without traffic; mesh mode reports
+            # every lane's own accountant instead of the executor's.
+            "device_busy_pct": (
+                {
+                    d: st["busy_pct"]
+                    for d, st in mesh["per_device"].items()
+                }
+                if mesh is not None
+                else {self._busy_acct.device: self._busy_acct.pct()}
+            ),
         }
         if mesh is not None:
             out["mesh"] = mesh
@@ -1357,6 +1380,17 @@ class VerificationScheduler:
             # stage run" the same way in every deployment shape
             st["prefetched_batches"] += st["mesh"]["prefetched_batches"]
         return st
+
+    def refresh_busy_gauges(self) -> None:
+        """Re-integrate every lane's busy window to NOW and republish the
+        `sched.device_busy_pct{device=}` gauges. Called by the /metrics
+        scrape path (engine_api/server.py): the gauges otherwise update
+        only on batch transitions, and an idle lane's last published
+        value would read frozen-busy forever on a metrics-only scraper."""
+        if self._pool is not None:
+            self._pool.refresh_busy()
+        else:
+            self._busy_acct.pct()
 
     def inflight_state(self) -> Optional[dict]:
         """The OLDEST batch currently in flight — `batch_id`, `lane`,
@@ -1711,6 +1745,10 @@ class VerificationScheduler:
             handle = engine.begin_batch(payload, prefetch=plan)
         else:
             handle = engine.begin_batch(payload)
+        # device-busy: the dispatch is enqueued — the lane's device owns
+        # this batch until the resolve worker finishes it (obs/busy.py;
+        # every exit path below pairs this with an end())
+        self._busy_acct.begin()
         pipe_item = {
             "jobs": jobs,
             "handle": handle,
@@ -1730,6 +1768,7 @@ class VerificationScheduler:
             # the worker died while we packed: the just-begun handle will
             # never be resolved — release its engine lease before failing
             _abandon_handle(engine, handle)
+            self._busy_acct.end()
             raise SchedulerDown(f"resolve worker is down: {dead!r}")
         with self._lock:
             self.stats["pipelined_batches"] += 1
@@ -2181,7 +2220,11 @@ class VerificationScheduler:
         # engine falls back device->native internally), so it propagates
         # to _run and takes the executor down — requests fail fast rather
         # than silently retrying into a broken engine.
-        verdicts = engine.verify_batch([(j.root, j.nodes) for j in jobs])
+        self._busy_acct.begin()
+        try:
+            verdicts = engine.verify_batch([(j.root, j.nodes) for j in jobs])
+        finally:
+            self._busy_acct.end()
         s1 = self._engine_cache_stats(engine)
         record = batch_record_from_stats(
             batch_id, len(jobs), jobs[0].bucket, s0, s1
@@ -2224,8 +2267,12 @@ class VerificationScheduler:
         if not jobs:
             return
         self._exec_stage = "dispatch"
-        handle = engine.begin_batch([j.plan for j in jobs])
-        results = engine.resolve_batch(handle)
+        self._busy_acct.begin()
+        try:
+            handle = engine.begin_batch([j.plan for j in jobs])
+            results = engine.resolve_batch(handle)
+        finally:
+            self._busy_acct.end()
         record = root_record_from_handle(
             handle, batch_id, len(jobs), jobs[0].bucket
         )
@@ -2314,8 +2361,12 @@ class VerificationScheduler:
         if not jobs:
             return
         self._exec_stage = "dispatch"
-        handle = engine.begin_batch([j.rows for j in jobs])
-        results = engine.resolve_batch(handle)
+        self._busy_acct.begin()
+        try:
+            handle = engine.begin_batch([j.rows for j in jobs])
+            results = engine.resolve_batch(handle)
+        finally:
+            self._busy_acct.end()
         record = sig_record_from_handle(
             handle, batch_id, len(jobs), jobs[0].bucket
         )
@@ -2562,6 +2613,14 @@ class VerificationScheduler:
             self._die(e, item["jobs"] if item else [], stage="resolve")
 
     def _resolve_one(self, item: dict) -> None:
+        try:
+            self._resolve_one_inner(item)
+        finally:
+            # device-busy: the [begin, resolve] interval closes whether
+            # the readback succeeded or the crash path takes over
+            self._busy_acct.end()
+
+    def _resolve_one_inner(self, item: dict) -> None:
         jobs = item["jobs"]
         handle = item["handle"]
         engine = item.get("engine") or self._engine
@@ -2643,8 +2702,10 @@ class VerificationScheduler:
         for item in dropped_items:
             # never resolved, never will be: release the engine leases so
             # a shared engine keeps evicting after this scheduler's death
-            # (each pipe item carries ITS engine — witness or root)
+            # (each pipe item carries ITS engine — witness or root), and
+            # close each one's device-busy interval (begun at handoff)
             _abandon_handle(item.get("engine") or self._engine, item["handle"])
+            self._busy_acct.end()
         for item in dropped_plans:
             plan = item.get("plan")
             if plan is not None:

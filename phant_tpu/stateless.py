@@ -999,7 +999,16 @@ def compute_post_root(state: WitnessStateDB) -> bytes:
 
                     sp = current_span()
                     if sp is not None:
-                        sp.attrs.update(meta)
+                        # root_-prefixed, like the sig lane's sig_ keys:
+                        # the open verify_block span already carries the
+                        # WITNESS batch record under the bare keys, and
+                        # un-prefixed root meta used to CLOBBER it
+                        # (queue_wait_ms/batch_id/stage/backend) — the
+                        # critpath rollup (obs/critpath.py) reads both
+                        # families apart by prefix
+                        sp.attrs.update(
+                            {f"root_{k}": v for k, v in meta.items()}
+                        )
                 return state.apply_post_root(prp, digests)
     return state.state_root()
 

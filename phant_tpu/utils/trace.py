@@ -40,7 +40,7 @@ import re
 import threading
 import time
 from dataclasses import dataclass, field
-from typing import Dict, Iterator, List, Optional, Tuple
+from typing import Dict, Iterator, List, Optional, Sequence, Tuple
 
 
 def scoped_logger(scope: str) -> logging.Logger:
@@ -72,6 +72,51 @@ DEFAULT_BUCKETS: Tuple[float, ...] = (
     0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05,
     0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
 )
+
+#: THE request-latency bucket table (engine_api.request_seconds and any
+#: future front-door latency family): one module-level constant so call
+#: sites can never drift apart on bucket bounds — a histogram's buckets
+#: are frozen at first observation, so two call sites with different
+#: tables would silently split the family. Extends DEFAULT_BUCKETS with
+#: an overload tail (PR 6's open-loop sweeps measured 15s p99s before
+#: the stateless gate existed): without buckets past 10s, the derived
+#: p99 gauge clamps to the last finite bound exactly when an operator
+#: most needs it.
+REQUEST_SECONDS_BUCKETS: Tuple[float, ...] = DEFAULT_BUCKETS + (30.0, 60.0)
+
+
+def histogram_quantile(
+    buckets: Sequence[float], counts: Sequence[int], q: float
+) -> float:
+    """Bucket-interpolated quantile over a fixed-bucket histogram (the
+    Prometheus `histogram_quantile` estimate, computed server-side so a
+    curl of /metrics answers "what's p99" without a PromQL engine).
+
+    `counts[i]` is the NON-cumulative count for bucket upper bound
+    `buckets[i]`, with `counts[-1]` the +Inf overflow slot — the
+    Histogram dataclass layout. Linear interpolation inside the target
+    bucket (lower bound 0 for the first); a target landing in the +Inf
+    slot clamps to the last finite bound (same behavior as PromQL —
+    the estimate is a floor there, which is why the exposition also
+    carries the exact `_sum`/`_count`). Returns 0.0 for an empty
+    histogram. An ESTIMATE by construction: resolution is the bucket
+    width around the target rank, never exact order statistics."""
+    total = sum(counts)
+    if total <= 0:
+        return 0.0
+    rank = q * total
+    cum = 0.0
+    for i, ub in enumerate(buckets):
+        prev_cum = cum
+        cum += counts[i]
+        if cum >= rank:
+            lo = buckets[i - 1] if i > 0 else 0.0
+            if counts[i] <= 0:
+                return float(ub)
+            frac = (rank - prev_cum) / counts[i]
+            return float(lo + (ub - lo) * min(max(frac, 0.0), 1.0))
+    # target rank lives in the +Inf slot: clamp to the last finite bound
+    return float(buckets[-1]) if buckets else 0.0
 
 
 @dataclass
@@ -230,9 +275,19 @@ METRIC_HELP: Dict[str, str] = {
     "sched.device_stall": "Scheduler waits for a free mesh lane slot (every device at its bound)",
     "sched.mesh_megabatches": "Full single-bucket batches dispatched as one whole-mesh sharded fused kernel call",
     "sched.megabatch_backlog_triggers": "Megabatches fired by the backlog-depth trigger (queued same-bucket work >= mesh width x k) rather than a full batch",
+    # per-lane device-busy accounting (phant_tpu/obs/busy.py)
+    "sched.device_busy_pct": "Rolling-window device-busy percentage per lane (device='mesh' = whole-mesh megabatch dispatches): the two-phase begin/resolve protocol brackets device occupancy, integrated as a union of in-flight intervals — 'the chip idles 60% at depth 1' read directly off /metrics or /healthz",
     # observability layer (phant_tpu/obs/)
     "sched.watchdog_stalls": "Executor stalls detected by the obs watchdog (in-flight batch past its deadline)",
     "flight.dumps": "Flight-recorder postmortem dumps written, by trigger reason",
+    # per-request critical-path attribution (phant_tpu/obs/critpath.py)
+    "critpath.phase_seconds": "Per-request critical-path phase time at verify_block span close, by phase (sig_rows/queue_wait/prefetch/pack/dispatch/resolve/witness_decode/sig_wait/evm/root_plan/root_wait/post_root) — phases tile the request's wall clock; derived from the span's own phase timers plus the batch records the serving lanes attach",
+    "critpath.wall_seconds": "verify_block request wall clock as seen by the critical-path rollup (the denominator of the coverage gauges)",
+    "critpath.unattributed_seconds": "Per-request residual the phase tiling could NOT attribute (span overhead, gaps between phases) — the honesty check's raw series",
+    "critpath.coverage_pct": "Cumulative attributed share of verify_block wall clock (the >=95% acceptance surface: anything lower means the phase tiling is missing a real cost)",
+    "critpath.unattributed_pct": "Cumulative UNattributed share of verify_block wall clock (100 - coverage) — the honesty-check residual gauge",
+    "critpath.requests": "verify_block spans rolled up by the critical-path attribution sink",
+    "obs.slow_captures": "Requests captured into the /debug/slow flight ring, by trigger (wall = --slo-budget-ms exceeded; a phase name = that phase's env budget exceeded)",
     # commitment schemes (phant_tpu/commitment/)
     "commitment.state_views": "Witness-backed state views constructed, by commitment scheme (mpt/binary) — the per-request scheme selector's audit trail",
     "commitment.witness_nodes": "Witness nodes generated by full-state witness collection (spec runner / differential harnesses), by scheme",
@@ -267,6 +322,8 @@ SPAN_HELP: Dict[str, str] = {
     "sched.executor_crash": "The scheduler executor died; carries the crashing batch's ids",
     "sched.stall": "The obs watchdog found the in-flight batch past its deadline",
     "flight.dump": "A postmortem dump was written to disk (reason + path)",
+    "obs.slow_capture": "A request blew its SLO budget (--slo-budget-ms wall clock, or a per-phase env override): carries the FULL span tree plus the critical-path breakdown — metrics say THAT it was slow, this exemplar says WHY (served at /debug/slow)",
+    "obs.profile": "An on-demand TPU profiler capture ran (POST /debug/profile): carries the trace directory, the captured window, and the artifact count",
 }
 
 
@@ -449,6 +506,30 @@ class Metrics:
             lab = f"{{{labels}}}" if labels else ""
             out.append(f"{family}_sum{lab} {fmt(h['sum'])}")
             out.append(f"{family}_count{lab} {h['count']}")
+        # derived p50/p99 gauges per histogram family: bucket-interpolated
+        # at scrape time (histogram_quantile above — an estimate bounded
+        # by bucket resolution, never exact order statistics; the raw
+        # bucket series stay the authoritative data). Emitted as separate
+        # `<family>_p50`/`<family>_p99` gauge families so a dashboard-less
+        # operator can read quantiles straight off a curl.
+        for q, suffix in ((0.5, "_p50"), (0.99, "_p99")):
+            for key in sorted(snap["histograms"]):
+                h = snap["histograms"][key]
+                if h["count"] <= 0:
+                    continue
+                base, labels = split_labels(key)
+                family = prometheus_name(base) + suffix
+                if family not in emitted_help:
+                    emitted_help.add(family)
+                    out.append(
+                        f"# HELP {family} bucket-interpolated "
+                        f"p{int(q * 100)} of {prometheus_name(base)} "
+                        "(derived at scrape; estimate, not exact)"
+                    )
+                    out.append(f"# TYPE {family} gauge")
+                lab = f"{{{labels}}}" if labels else ""
+                v = histogram_quantile(h["buckets"], h["counts"], q)
+                out.append(f"{family}{lab} {fmt(float(v))}")
         for key in sorted(snap["timers"]):
             base, labels = split_labels(key)
             family = prometheus_name(base)

@@ -117,6 +117,18 @@ def _direction(key: str) -> Optional[str]:
         # measured case for the offload gates), and the parity echoes
         # (`_parity_pct`) fall through to informational.
         return "up"
+    if key == "obs_overhead_pct":
+        # obs_overhead (round 15): the median paired attribution-on vs
+        # -off delta on the depth-2 serving path — GROWTH means the
+        # observability layer is eating into serving throughput (the A/A
+        # bar `obs_overhead_noise_aa_pct` stays informational, like every
+        # other section's noise echo).
+        return "down"
+    if key == "obs_overhead_coverage_pct":
+        # the critical-path coverage claim (attributed share of request
+        # wall clock, >= 95 asserted in-section): a SHRINKING value means
+        # the phase tiling stopped covering a real cost.
+        return "up"
     if key.endswith("_savings_vs_mpt_pct"):
         # commitment_compare (round 12): the binary backend's witness-byte
         # savings over the hexary MPT baseline on the same span — a

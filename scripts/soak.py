@@ -183,6 +183,9 @@ def main() -> int:
     rc = _commitment_phase()
     if rc:
         return rc
+    rc = _slo_phase()
+    if rc:
+        return rc
     return _qos_phase()
 
 
@@ -838,6 +841,117 @@ def _sender_lane_phase() -> int:
         "byte-identical (invalid-sig + pre-EIP-155 blocks included), "
         "induced sig-dispatch crash fails only in-flight with a "
         "stage-named dump"
+    )
+    return 0
+
+
+def _slo_phase() -> int:
+    """SLO exemplar capture under live traffic (PR 15): the soak's mixed
+    request shape against a server whose `--slo-budget-ms` is
+    deliberately impossible (0.01ms — every request violates). Asserts:
+    violations are COUNTED (`obs.slow_captures{trigger=wall}`),
+    exemplars LAND in /debug/slow over real HTTP with stage-named
+    critical-path phases and the full span tree, and the stall watchdog
+    stays QUIET throughout — slow is an SLO event, not a wedged
+    executor, and conflating them would bury the real stall signal."""
+    from phant_tpu.engine_api.server import EngineAPIServer
+    from phant_tpu.obs import critpath
+    from phant_tpu.obs.flight import flight
+    from phant_tpu.serving import SchedulerConfig
+    from phant_tpu.utils.trace import metrics
+
+    from test_serving import _post, _stateless_request
+
+    failures: list = []
+    n_requests = int(os.environ.get("PHANT_SOAK_SLO_REQUESTS", "12"))
+    os.environ["PHANT_SLO_BUDGET_MS"] = "0.01"
+    critpath.slow.clear()
+    seq_before = (flight.records() or [{}])[-1].get("seq", 0)
+    counters_before = metrics.snapshot()["counters"]
+    slow_before = sum(
+        v
+        for k, v in counters_before.items()
+        if k.startswith("obs.slow_captures")
+    )
+    try:
+        stateless_chain, stateless_rpc, _want_root = _stateless_request()
+        server = EngineAPIServer(
+            stateless_chain,
+            host="127.0.0.1",
+            port=0,
+            sched_config=SchedulerConfig(
+                max_batch=8, max_wait_ms=5.0, queue_depth=256
+            ),
+        )
+        server.serve_in_background()
+        base = f"http://127.0.0.1:{server.port}"
+        try:
+            with ThreadPoolExecutor(max_workers=4) as pool:
+                for code, body in pool.map(
+                    lambda _i: _post(base, stateless_rpc), range(n_requests)
+                ):
+                    if code != 200 or body["result"]["status"] != "VALID":
+                        failures.append(f"stateless failed ({code}): {body}")
+            import json
+
+            code, raw = _get(base, "/debug/slow")
+            if code != 200:
+                failures.append(f"/debug/slow HTTP {code}")
+                slow_body = {"records": []}
+            else:
+                slow_body = json.loads(raw)
+        finally:
+            server.shutdown()
+    finally:
+        os.environ.pop("PHANT_SLO_BUDGET_MS", None)
+        critpath.refresh_from_env()
+
+    counters_after = metrics.snapshot()["counters"]
+    slow_after = sum(
+        v
+        for k, v in counters_after.items()
+        if k.startswith("obs.slow_captures")
+    )
+    if slow_after - slow_before < n_requests:
+        failures.append(
+            f"slow captures undercounted: {slow_after - slow_before} < "
+            f"{n_requests} violating requests"
+        )
+    records = slow_body.get("records", [])
+    if not records:
+        failures.append("no exemplars in /debug/slow under a 0.01ms budget")
+    for rec in records[-3:]:
+        if rec.get("kind") != "obs.slow_capture":
+            failures.append(f"unexpected slow-ring record kind: {rec.get('kind')}")
+            continue
+        breakdown = rec.get("breakdown_ms") or {}
+        bad = [ph for ph in breakdown if ph not in critpath.PHASES]
+        if bad or not breakdown:
+            failures.append(
+                f"exemplar breakdown not stage-named: {sorted(breakdown)}"
+            )
+        sp = rec.get("span") or {}
+        if sp.get("span") != "verify_block" or "phases" not in sp:
+            failures.append(f"exemplar lacks the full span tree: {sp.get('span')}")
+    # slow != stalled: the watchdog's deadline allowance (30s) was never
+    # threatened by an SLO budget of 0.01ms — any stall record here means
+    # the two signals got conflated
+    stalls = [
+        r
+        for r in flight.records()
+        if r.get("kind") == "sched.stall" and r.get("seq", 0) > seq_before
+    ]
+    if stalls:
+        failures.append(f"watchdog fired on merely-slow traffic: {stalls}")
+
+    if failures:
+        for f in failures:
+            print(f"[soak] FAIL (slo phase): {f}", file=sys.stderr)
+        return 1
+    print(
+        f"[soak] slo phase green: {slow_after - slow_before} violations "
+        f"counted, {len(records)} exemplars in /debug/slow with stage-named "
+        "phases, watchdog quiet"
     )
     return 0
 

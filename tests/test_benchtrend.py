@@ -495,3 +495,45 @@ def test_sender_lane_speedup_regression_flags(tmp_path):
     _write_round(tmp_path, 4, {"sender_lane_coalesce_speedup_pct": 15.0})
     rows, flags = benchtrend.analyze(str(tmp_path), 0.4, 2)
     assert any("sender_lane_coalesce_speedup_pct" in f for f in flags)
+
+
+def test_obs_overhead_key_directions():
+    """Round-15 `obs_overhead` section keys: the attribution-on/off
+    median paired overhead gates DOWN (growth = the observability layer
+    eating serving throughput) and the critical-path coverage gates UP
+    (shrinking = the phase tiling stopped covering a real cost); the
+    on/off serving rates trend via `_per_sec`, the A/A noise bar and
+    shape echoes stay informational. Pinned so a key rework cannot
+    un-gate the PR 15 claims."""
+    d = benchtrend._direction
+    assert d("obs_overhead_pct") == "down"
+    assert d("obs_overhead_coverage_pct") == "up"
+    assert d("obs_overhead_on_blocks_per_sec") == "up"
+    assert d("obs_overhead_off_blocks_per_sec") == "up"
+    assert d("obs_overhead_noise_aa_pct") is None
+    assert d("obs_overhead_blocks") is None
+    assert d("obs_overhead_pairs") is None
+    assert d("obs_overhead_verdict_identity") is None
+
+
+def test_obs_overhead_regression_flags(tmp_path):
+    """Attribution overhead blowing past its noise history must flag —
+    the committed claim is 'within the A/A bar', and a 10x growth is the
+    layer silently landing on the serving hot path. A collapsed
+    coverage flags too (the honesty gauge's trend twin)."""
+    for n, (o, c) in enumerate(
+        [(2.9, 99.9), (3.1, 99.8), (2.7, 99.9)], start=1
+    ):
+        _write_round(
+            tmp_path,
+            n,
+            {"obs_overhead_pct": o, "obs_overhead_coverage_pct": c},
+        )
+    _write_round(
+        tmp_path,
+        4,
+        {"obs_overhead_pct": 31.0, "obs_overhead_coverage_pct": 48.0},
+    )
+    rows, flags = benchtrend.analyze(str(tmp_path), 0.4, 2)
+    assert any("obs_overhead_pct" in f for f in flags)
+    assert any("obs_overhead_coverage_pct" in f for f in flags)

@@ -804,9 +804,9 @@ def test_mesh_exec_is_in_hostsync_scope(mutated_tree, monkeypatch):
     p = mutated_tree / "phant_tpu" / "serving" / "mesh_exec.py"
     src = p.read_text()
     mutated = src.replace(
-        "                    verdicts = eng2.resolve_batch(handle)\n",
-        "                    verdicts = eng2.resolve_batch(handle)\n"
-        "                    _n = verdicts.sum().item()\n",
+        "                        verdicts = eng2.resolve_batch(handle)\n",
+        "                        verdicts = eng2.resolve_batch(handle)\n"
+        "                        _n = verdicts.sum().item()\n",
         1,
     )
     assert mutated != src
@@ -1064,3 +1064,37 @@ def test_sig_engine_is_in_hostsync_scope(mutated_tree, monkeypatch):
     hits = [f for f in res.new if f.rule == "HOSTSYNC" and ".item()" in f.message]
     assert hits, [f.render() for f in res.new]
     assert any("sig_engine" in f.path for f in hits)
+
+
+def test_busy_integration_is_in_hostsync_scope(mutated_tree, monkeypatch):
+    """The busy-time integration points (PR 15) are HOSTSYNC-scoped: the
+    pipeline handoff (busy begin, right after the no-sync begin_batch)
+    and the resolve worker (busy end) are in DEFAULT_ENTRIES, and a
+    stray `.item()` reintroduced next to the busy bracket turns the
+    gate red — observability must never put a device sync on the
+    serving hot path."""
+    from phant_tpu.analysis.rules.hostsync import DEFAULT_ENTRIES
+
+    assert (
+        "phant_tpu.serving.scheduler.VerificationScheduler._pipeline_handoff"
+        in DEFAULT_ENTRIES
+    )
+    assert (
+        "phant_tpu.serving.scheduler.VerificationScheduler._resolve_run"
+        in DEFAULT_ENTRIES
+    )
+    p = mutated_tree / "phant_tpu" / "serving" / "scheduler.py"
+    src = p.read_text()
+    mutated = src.replace(
+        "        self._busy_acct.begin()\n        pipe_item = {\n",
+        "        self._busy_acct.begin()\n"
+        "        _n = handle.total.item()\n"
+        "        pipe_item = {\n",
+        1,
+    )
+    assert mutated != src
+    p.write_text(mutated)
+    res = _analyze_repo_tree(mutated_tree, monkeypatch)
+    hits = [f for f in res.new if f.rule == "HOSTSYNC" and ".item()" in f.message]
+    assert hits, [f.render() for f in res.new]
+    assert any("scheduler" in f.path for f in hits)
