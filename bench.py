@@ -3254,6 +3254,152 @@ def sec_obs_overhead() -> dict:
     return frag
 
 
+def sec_timeline_overhead() -> dict:
+    """Timeline recorder overhead (PR 16): the proof the tail-sampled
+    timeline layer (obs/timeline.py — the third span sink plus the
+    scheduler batch and device-busy taps) is free enough to leave ON, on
+    the same depth-2 serving path and with the same statistics discipline
+    as `obs_overhead`: MEDIAN of PAIRED interleaved on/off runs against a
+    same-statistic A/A (on vs on) noise bar — acceptance is
+    `timeline_overhead_pct` WITHIN `timeline_overhead_noise_aa_pct`,
+    never a raw delta. The attribution layer stays ON in BOTH legs (the
+    A/B isolates the timeline increment). In-section the on legs must
+    also prove the layer WORKS: verdict identity (the recorder may never
+    change an answer), tail-sampling reconciliation — kept + sampled_out
+    EXACTLY equals offered load (sampling is never silent), and a final
+    export must parse as Chrome-trace JSON with events in it."""
+    import json as _json
+    import random as _random
+    import threading
+
+    from phant_tpu import serving
+    from phant_tpu.obs import critpath, timeline
+    from phant_tpu.ops.witness_engine import WitnessEngine
+    from phant_tpu.serving.scheduler import (
+        SchedulerConfig,
+        VerificationScheduler,
+    )
+    from phant_tpu.stateless import verify_witness_nodes
+    from phant_tpu.utils.trace import metrics as _m
+    from phant_tpu.utils.trace import span, trace_context
+
+    warm, chain = _witness_chain()
+    n = len(chain)
+    pairs = int(os.environ.get("PHANT_BENCH_OBS_PAIRS", "5"))
+    workers = int(os.environ.get("PHANT_BENCH_OBS_THREADS", "8"))
+    mb = int(os.environ.get("PHANT_BENCH_STREAM_BATCH", "16"))
+    sample_n = int(os.environ.get("PHANT_TIMELINE_SAMPLE_N", "16"))
+
+    eng = WitnessEngine()
+    wb = int(os.environ.get("PHANT_BENCH_ENGINE_BATCH", "256"))
+    for i in range(0, len(warm), wb):
+        assert eng.verify_batch(warm[i : i + wb]).all()
+    want = [bool(v) for v in eng.verify_batch(chain)]
+
+    def leg(enabled: bool) -> float:
+        timeline.configure(enabled=enabled)
+        got: list = [None] * n
+        with VerificationScheduler(
+            engine=eng,
+            config=SchedulerConfig(
+                max_batch=mb,
+                max_wait_ms=4.0,
+                queue_depth=n + 1,
+                pipeline_depth=2,
+            ),
+        ) as s:
+            serving.install(s)
+            try:
+                pending = list(range(n))
+                plock = threading.Lock()
+
+                def drive() -> None:
+                    while True:
+                        with plock:
+                            if not pending:
+                                return
+                            i = pending.pop()
+                        root, nodes = chain[i]
+                        with trace_context(), span(
+                            "verify_block", block=i, nodes=len(nodes), codes=0
+                        ):
+                            with _m.phase("stateless.witness_verify"):
+                                got[i] = verify_witness_nodes(root, nodes)
+
+                t0 = time.perf_counter()
+                threads = [
+                    threading.Thread(target=drive) for _ in range(workers)
+                ]
+                for t in threads:
+                    t.start()
+                for t in threads:
+                    t.join()
+                dt = time.perf_counter() - t0
+            finally:
+                serving.uninstall(s)
+        assert got == want, "timeline recorder changed a verdict"
+        return dt
+
+    try:
+        critpath.configure(enabled=True)
+        leg(True)  # warm the serving path; discarded
+        # reconciliation window starts HERE: every request driven with
+        # the recorder on from now must land in kept or sampled_out
+        timeline.reset()
+        timeline.configure(
+            sample_n=sample_n, rng=_random.Random(0xF00D)
+        )
+        d_on: list = []
+        d_off: list = []
+        deltas: list = []
+        aa: list = []
+        for _ in range(pairs):
+            off = leg(False)
+            on = leg(True)
+            on2 = leg(True)  # the A/A twin measures the box, not the code
+            d_off.append(off)
+            d_on.append(on)
+            deltas.append(on / off - 1.0)
+            aa.append(abs(1.0 - on2 / on))
+        st = timeline.stats()
+        export = timeline.export(window_s=3600.0)
+    finally:
+        timeline.configure(enabled=True)
+    offered = 2 * pairs * n  # the on + on2 legs; off legs record nothing
+    kept_total = sum(st["kept"].values())
+    sampled_out = st["dropped"].get("sampled_out", 0)
+    # THE in-section acceptance: tail-sampling is never silent — the
+    # counters reconcile EXACTLY with offered load (ring_full evictions
+    # count previously-kept entries and stay out of this identity)
+    assert kept_total + sampled_out == offered, (
+        f"timeline counters leak: kept {kept_total} + sampled_out "
+        f"{sampled_out} != offered {offered}"
+    )
+    # and the export is real Chrome-trace JSON with the load in it
+    events = _json.loads(_json.dumps(export, default=str))["traceEvents"]
+    assert events, "timeline export came back empty"
+    deltas.sort()
+    aa.sort()
+    frag = {
+        "timeline_overhead_blocks": n,
+        "timeline_overhead_pairs": pairs,
+        "timeline_overhead_workers": workers,
+        "timeline_overhead_sample_n": sample_n,
+        "timeline_overhead_off_blocks_per_sec": round(n / min(d_off), 2),
+        "timeline_overhead_on_blocks_per_sec": round(n / min(d_on), 2),
+        "timeline_overhead_pct": round(deltas[len(deltas) // 2] * 100, 2),
+        "timeline_overhead_noise_aa_pct": round(aa[len(aa) // 2] * 100, 2),
+        "timeline_overhead_kept": kept_total,
+        "timeline_overhead_sampled_out": sampled_out,
+        "timeline_overhead_offered": offered,
+        "timeline_overhead_export_events": len(events),
+        "timeline_overhead_reconciled": 1,  # the assert above would raise
+        "timeline_overhead_verdict_identity": 1,  # leg asserts would raise
+    }
+    _bank(frag)
+    return frag
+
+
 # priority order matters: when the tunnel window is short, the headline
 # engine number and the GLV proof come first
 _CPU_SECTIONS = {
@@ -3262,6 +3408,7 @@ _CPU_SECTIONS = {
     "serving_mesh": sec_serving_mesh,
     "commitment_compare": sec_commitment_compare,
     "obs_overhead": sec_obs_overhead,
+    "timeline_overhead": sec_timeline_overhead,
     "replay": sec_replay_cpu,
     "state_root": sec_state_root_cpu,
     "ecrecover": sec_ecrecover_cpu,

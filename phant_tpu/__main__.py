@@ -116,6 +116,32 @@ def build_parser() -> argparse.ArgumentParser:
         "by PHANT_PROFILE_MAX_S). Default: PHANT_PROFILE_DIR or "
         "build/profile",
     )
+    p.add_argument(
+        "--timeline-sample-n",
+        type=int,
+        default=None,
+        help="Uniform 1-in-N tail-sampling rate of the timeline recorder "
+        "(GET /debug/timeline): SLO violators, crashed requests, and "
+        "per-phase p99 exemplars are always kept; 1 keeps everything, "
+        "0 keeps only the always-kept tiers. "
+        "Default: PHANT_TIMELINE_SAMPLE_N or 16",
+    )
+    p.add_argument(
+        "--timeline-dir",
+        type=str,
+        default=None,
+        help="Spool every timeline export to rotated JSON files under "
+        "this directory (newest PHANT_TIMELINE_KEEP kept). "
+        "Default: PHANT_TIMELINE_DIR or off",
+    )
+    p.add_argument(
+        "--flight-ring",
+        type=int,
+        default=None,
+        help="Capacity (records) of the /debug/flight postmortem ring, "
+        "resolved once at server construction; /healthz echoes all "
+        "debug-ring capacities. Default: PHANT_FLIGHT_RING or 2048",
+    )
     # continuous-batching scheduler (phant_tpu/serving/): the knobs of the
     # admission-queue -> batch-assembler -> executor pipeline
     p.add_argument(
@@ -333,15 +359,22 @@ def main(argv=None) -> int:
         import os
 
         os.environ["PHANT_HTTP_TIMEOUT_S"] = str(args.http_timeout_s)
-    if args.slo_budget_ms is not None or args.profile_dir is not None:
+    obs_flags = (
+        ("PHANT_SLO_BUDGET_MS", args.slo_budget_ms),
+        ("PHANT_PROFILE_DIR", args.profile_dir),
+        ("PHANT_TIMELINE_SAMPLE_N", args.timeline_sample_n),
+        ("PHANT_TIMELINE_DIR", args.timeline_dir),
+        ("PHANT_FLIGHT_RING", args.flight_ring),
+    )
+    if any(v is not None for _k, v in obs_flags):
         # observability knobs ride the env (the server re-resolves the
-        # memoized attribution config at construction)
+        # memoized obs configs — attribution, timeline, flight ring —
+        # ONCE at construction)
         import os
 
-        if args.slo_budget_ms is not None:
-            os.environ["PHANT_SLO_BUDGET_MS"] = str(args.slo_budget_ms)
-        if args.profile_dir is not None:
-            os.environ["PHANT_PROFILE_DIR"] = args.profile_dir
+        for key, val in obs_flags:
+            if val is not None:
+                os.environ[key] = str(val)
     sched_config = SchedulerConfig(**sched_kwargs)
     server = EngineAPIServer(
         chain,

@@ -40,7 +40,10 @@ liveness, per-lane `device_busy_pct`) and turns 503 when the executor has
 died; `GET /debug/flight` serves the obs flight recorder's ring (recent
 spans / errors / scheduler transitions) live, `GET /debug/slow` the
 SLO-exemplar ring (obs/critpath.py — full span trees of requests that
-blew `--slo-budget-ms`), `POST /debug/profile?seconds=T` grabs an
+blew `--slo-budget-ms`), `GET /debug/timeline?window=S` the unified
+tail-sampled timeline as Perfetto-loadable Chrome-trace JSON
+(obs/timeline.py — requests, lane batches, device busy windows on one
+time axis), `POST /debug/profile?seconds=T` grabs an
 on-demand, single-flight-guarded `jax_profile` capture into
 `--profile-dir` (obs/profiler.py), and the first `/healthz` flip to 503
 auto-dumps the flight ring to `build/flight/` (phant_tpu/obs/). Every POST runs inside its own trace
@@ -55,13 +58,15 @@ from __future__ import annotations
 
 import json
 import logging
+import math
 import os
 import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from phant_tpu.engine_api import handle_request
-from phant_tpu.obs import critpath, flight, profiler
+from phant_tpu.obs import critpath, flight, profiler, timeline
+from phant_tpu.obs.flight import refresh_from_env as _refresh_flight_ring
 from phant_tpu.serving import (
     PRIORITY_BACKFILL,
     PRIORITY_HEAD,
@@ -187,6 +192,13 @@ def _healthz_payload() -> tuple:
         if not st["executor_alive"]:
             payload["status"] = "unhealthy"
             status = 503
+    # every debug-ring capacity in one place (the --flight-ring /
+    # --timeline-* config surfaces echo back what actually took effect)
+    payload["debug_rings"] = {
+        "flight": flight.capacity,
+        "slow": critpath.slow.capacity,
+        "timeline": timeline.capacity(),
+    }
     with _healthz_lock:
         if status == 503:
             flipped = sched is not _healthz_dumped_for
@@ -256,6 +268,30 @@ class _ObservableHandler(BaseHTTPRequestHandler):
                 ).encode(),
                 "application/json",
             )
+        elif path == "/debug/timeline":
+            # the unified timeline (obs/timeline.py): the last `window`
+            # seconds of kept requests, lane batches, device busy
+            # windows, and profiler captures as Perfetto-loadable
+            # Chrome-trace JSON — curl it straight into ui.perfetto.dev
+            query = self.path.partition("?")[2]
+            params = dict(
+                p.split("=", 1) for p in query.split("&") if "=" in p
+            )
+            try:
+                window = float(params.get("window", "60"))
+            except ValueError:
+                window = float("nan")
+            if not math.isfinite(window) or window <= 0:
+                self._reply(
+                    400,
+                    {"error": "window must be a positive number of seconds"},
+                )
+            else:
+                self._reply_raw(
+                    200,
+                    json.dumps(timeline.export(window), default=str).encode(),
+                    "application/json",
+                )
         elif path == "/debug/slow":
             # SLO-busting exemplars (obs/critpath.py): full span trees +
             # critical-path breakdowns of every request that blew
@@ -373,12 +409,14 @@ class EngineAPIServer:
         sched_config: SchedulerConfig = None,
     ):
         self.blockchain = blockchain
-        # re-resolve the attribution layer's memoized config NOW: the CLI
-        # writes --slo-budget-ms / --profile-dir into the env before
-        # constructing the server, and tests monkeypatch the same keys
-        # (obs/critpath.py documents why the config is not re-read per
-        # request)
+        # re-resolve the obs layers' memoized configs NOW: the CLI writes
+        # --slo-budget-ms / --profile-dir / --timeline-* / --flight-ring
+        # into the env before constructing the server, and tests
+        # monkeypatch the same keys (obs/critpath.py documents why the
+        # config is not re-read per request/event)
         critpath.refresh_from_env()
+        timeline.refresh_from_env()
+        _refresh_flight_ring()
         self._owns_scheduler = scheduler is None
         if scheduler is None:
             scheduler = VerificationScheduler(config=sched_config)

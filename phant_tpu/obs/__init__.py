@@ -34,15 +34,21 @@ died. This package is that layer:
 * **On-demand profiler** (`profiler.py`, PR 15): `POST /debug/profile`
   grabs a single-flight-guarded, hard-capped `jax_profile` window from a
   live server.
+* **Timeline export** (`timeline.py`, PR 16): a third span sink plus
+  batch/busy/profiler taps tail-sample the serving path into a bounded
+  recorder, rendered as Perfetto-loadable Chrome-trace JSON at
+  `GET /debug/timeline?window=S` — requests, lane batches, and device
+  busy windows on one time axis, stitched by flow events.
 
-Importing this package registers the flight recorder and the critpath
-rollup as span sinks, so any module that touches obs gets span mirroring
-and attribution for free; the registrations are idempotent.
+Importing this package registers the flight recorder, the critpath
+rollup, and the timeline recorder as span sinks, so any module that
+touches obs gets span mirroring, attribution, and timeline capture for
+free; the registrations are idempotent.
 """
 
 from __future__ import annotations
 
-from phant_tpu.obs import critpath
+from phant_tpu.obs import critpath, timeline
 from phant_tpu.obs.busy import BusyAccountant
 from phant_tpu.obs.flight import FlightRecorder, flight
 from phant_tpu.obs.watchdog import Watchdog
@@ -55,6 +61,7 @@ __all__ = [
     "critpath",
     "flight",
     "record_span",
+    "timeline",
 ]
 
 
@@ -65,3 +72,4 @@ def record_span(record: dict) -> None:
 
 add_span_sink(record_span)
 add_span_sink(critpath.rollup)
+add_span_sink(timeline.on_span)

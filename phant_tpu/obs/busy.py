@@ -32,6 +32,12 @@ stage's host-side readback/commit work — on a real accelerator that is a
 small tail; on the XLA-CPU proxy (whose "device" shares the host cores)
 the gauge reads host+device occupancy of the lane, not chip utilization.
 
+Timeline tap (PR 16): the union-of-intervals open count already marks
+exactly when the device goes from idle to occupied (0 -> 1) and back
+(1 -> 0) — each closed occupancy window is forwarded to the timeline
+recorder (obs/timeline.py) as a busy slice on the device's track, wall-
+clock stamped at the transition. The forward happens OUTSIDE our lock.
+
 Thread-safety: one small lock per accountant; begin/end/pct are O(1)
 arithmetic, cheap enough for the per-batch serving path. Gauge publishes
 go through the metrics registry's own lock (never nested under ours).
@@ -46,6 +52,7 @@ import threading
 import time
 from typing import Callable, Optional
 
+from phant_tpu.obs import timeline
 from phant_tpu.utils.trace import metrics
 
 #: default rolling half-window (seconds); two buckets => the gauge always
@@ -72,6 +79,7 @@ class BusyAccountant:
         self._lock = threading.Lock()
         now = self._clock()
         self._open = 0  # in-flight [begin, resolve] intervals
+        self._open_wall = 0.0  # wall clock of the last 0->1 transition
         self._last = now  # last integration timestamp
         self._win_start = now
         self._busy_cur = 0.0  # busy seconds in the current bucket
@@ -111,6 +119,9 @@ class BusyAccountant:
             return
         with self._lock:
             self._advance_locked(self._clock())
+            if self._open == 0:
+                # idle -> occupied: the timeline busy slice opens here
+                self._open_wall = time.time()
             self._open += 1
 
     def end(self) -> None:
@@ -118,13 +129,19 @@ class BusyAccountant:
         the interval closes either way; extra end() calls clamp at 0)."""
         if not self.enabled:
             return
+        closed = None
         with self._lock:
             self._advance_locked(self._clock())
             if self._open > 0:
                 self._open -= 1
+                if self._open == 0 and self._open_wall > 0.0:
+                    # occupied -> idle: one closed union interval
+                    closed = (self._open_wall, time.time())
             pct = self._pct_locked()
         if self._publish:
             metrics.gauge_set("sched.device_busy_pct", pct, device=self.device)
+        if closed is not None and timeline.enabled():
+            timeline.record_busy(self.device, closed[0], closed[1])
 
     def _pct_locked(self) -> float:
         span = self._prev_span + (self._last - self._win_start)

@@ -47,6 +47,16 @@ PHANT_SLO_BUDGET_MS_QUEUE_WAIT) — is captured as its FULL span tree plus
 the breakdown into a dedicated bounded flight ring, served at
 `GET /debug/slow` and counted in `obs.slow_captures{trigger=}`.
 
+Near-budget tier (PR 16, closing PR 15's named open): on a healthy
+server the violation ring is EMPTY — there is nothing to read when an
+operator asks "what do our slowest-but-passing requests look like". A
+request that lands in the top `PHANT_SLO_NEAR_PCT` percent of the
+budget (wall > budget * (1 - near_pct/100) without blowing it) is
+captured at a sampled 1-in-`PHANT_SLO_NEAR_SAMPLE_N` rate with
+`trigger=near`; its `over_ms` is NEGATIVE — the remaining headroom.
+The sampler's RNG is injectable via `configure(near_rng=...)` so tests
+pin the decision sequence.
+
 Config is resolved ONCE from the environment and memoized (the env-read-
 per-request pattern is exactly what the PR 14 signer bugfix removed from
 the hot path); `refresh_from_env()` re-reads it (the Engine API server
@@ -65,6 +75,7 @@ treats malformed records as zero-valued.
 from __future__ import annotations
 
 import os
+import random
 import threading
 from typing import Dict, Optional, Tuple
 
@@ -98,24 +109,38 @@ slow = FlightRecorder(
 
 
 class _Config:
-    __slots__ = ("enabled", "budget_ms", "phase_budgets_ms")
+    __slots__ = (
+        "enabled",
+        "budget_ms",
+        "phase_budgets_ms",
+        "near_pct",
+        "near_sample_n",
+    )
 
     def __init__(
         self,
         enabled: bool,
         budget_ms: float,
         phase_budgets_ms: Dict[str, float],
+        near_pct: float,
+        near_sample_n: int,
     ):
         self.enabled = enabled
         self.budget_ms = budget_ms
         self.phase_budgets_ms = phase_budgets_ms
+        self.near_pct = near_pct
+        self.near_sample_n = near_sample_n
+
+
+def _env_num(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, str(default)) or str(default))
+    except ValueError:
+        return default
 
 
 def _config_from_env() -> _Config:
-    try:
-        budget = float(os.environ.get("PHANT_SLO_BUDGET_MS", "0") or "0")
-    except ValueError:
-        budget = 0.0
+    budget = _env_num("PHANT_SLO_BUDGET_MS", 0.0)
     phase_budgets: Dict[str, float] = {}
     for ph in PHASES:
         raw = os.environ.get(f"PHANT_SLO_BUDGET_MS_{ph.upper()}")
@@ -131,11 +156,16 @@ def _config_from_env() -> _Config:
         enabled=os.environ.get("PHANT_OBS_ATTRIBUTION", "1") not in ("0", ""),
         budget_ms=budget,
         phase_budgets_ms=phase_budgets,
+        near_pct=min(max(_env_num("PHANT_SLO_NEAR_PCT", 0.0), 0.0), 100.0),
+        near_sample_n=max(int(_env_num("PHANT_SLO_NEAR_SAMPLE_N", 8.0)), 0),
     )
 
 
 _cfg: _Config = _config_from_env()
 _cfg_lock = threading.Lock()
+
+#: near-budget tier sampler; tests pin it via configure(near_rng=...)
+_near_rng = random.Random()
 
 
 def refresh_from_env() -> None:
@@ -151,10 +181,14 @@ def configure(
     enabled: Optional[bool] = None,
     budget_ms: Optional[float] = None,
     phase_budgets_ms: Optional[Dict[str, float]] = None,
+    near_pct: Optional[float] = None,
+    near_sample_n: Optional[int] = None,
+    near_rng: Optional[random.Random] = None,
 ) -> None:
     """Override the memoized config directly (tests, the bench A/B legs);
-    None leaves a field as-is."""
-    global _cfg
+    None leaves a field as-is. `near_rng` replaces the near-tier sampler's
+    generator (determinism for tests)."""
+    global _cfg, _near_rng
     with _cfg_lock:
         _cfg = _Config(
             enabled=_cfg.enabled if enabled is None else enabled,
@@ -164,7 +198,15 @@ def configure(
                 if phase_budgets_ms is None
                 else dict(phase_budgets_ms)
             ),
+            near_pct=_cfg.near_pct if near_pct is None else near_pct,
+            near_sample_n=(
+                _cfg.near_sample_n
+                if near_sample_n is None
+                else max(int(near_sample_n), 0)
+            ),
         )
+        if near_rng is not None:
+            _near_rng = near_rng
 
 
 def enabled() -> bool:
@@ -323,12 +365,31 @@ def rollup(record: dict) -> None:
     metrics.gauge_set("critpath.coverage_pct", round(cov, 2))
     metrics.gauge_set("critpath.unattributed_pct", round(100.0 - cov, 2))
     # SLO exemplars: wall budget first (the headline trigger), then the
-    # per-phase overrides — ONE capture per request, first trigger wins
+    # sampled near-budget tier, then the per-phase overrides — ONE
+    # capture per request, first trigger wins
     if cfg.budget_ms > 0 and wall > cfg.budget_ms:
         _capture_slow(
             record, breakdown, wall, "wall", cfg.budget_ms, wall - cfg.budget_ms
         )
         return
+    if (
+        cfg.budget_ms > 0
+        and cfg.near_pct > 0
+        and wall > cfg.budget_ms * (1.0 - cfg.near_pct / 100.0)
+    ):
+        n = cfg.near_sample_n
+        if n == 1 or (n > 1 and _near_rng.randrange(n) == 0):
+            # over_ms is NEGATIVE here: the headroom this near-miss
+            # still had under the budget
+            _capture_slow(
+                record,
+                breakdown,
+                wall,
+                "near",
+                cfg.budget_ms,
+                wall - cfg.budget_ms,
+            )
+            return
     for label, limit in cfg.phase_budgets_ms.items():
         v = breakdown.get(label, 0.0)
         if v > limit:

@@ -38,8 +38,24 @@ from typing import List, Optional
 
 from phant_tpu.utils.trace import current_trace_id, metrics
 
-#: default ring capacity (records); override with PHANT_FLIGHT_CAPACITY
+#: default ring capacity (records); override with --flight-ring /
+#: PHANT_FLIGHT_RING (PHANT_FLIGHT_CAPACITY kept as the legacy alias)
 _DEFAULT_CAPACITY = 2048
+
+
+def _capacity_from_env() -> int:
+    """Resolve the global ring's capacity ONCE (module import and
+    `refresh_from_env()` — never per record): PHANT_FLIGHT_RING wins,
+    the pre-PR-16 PHANT_FLIGHT_CAPACITY spelling still works."""
+    raw = os.environ.get(
+        "PHANT_FLIGHT_RING",
+        os.environ.get("PHANT_FLIGHT_CAPACITY", str(_DEFAULT_CAPACITY)),
+    )
+    try:
+        v = int(raw or str(_DEFAULT_CAPACITY))
+    except ValueError:
+        return _DEFAULT_CAPACITY
+    return max(v, 1)
 
 
 def _flight_dir() -> str:
@@ -80,6 +96,15 @@ class FlightRecorder:
         with self._lock:
             return list(self._ring)
 
+    def resize(self, capacity: int) -> None:
+        """Rebuild the ring at a new capacity, keeping the NEWEST records
+        (a shrink drops from the oldest end — ring semantics)."""
+        capacity = max(int(capacity), 1)
+        with self._lock:
+            if self._ring.maxlen != capacity:
+                self._ring = deque(self._ring, maxlen=capacity)
+            self.capacity = capacity
+
     def clear(self) -> None:
         with self._lock:
             self._ring.clear()
@@ -97,17 +122,18 @@ class FlightRecorder:
         to the newest PHANT_FLIGHT_KEEP files."""
         d = dirpath or _flight_dir()
         snap = self.records()
+        with self._lock:
+            self._dump_seq += 1
+            dump_n = self._dump_seq  # same-second same-reason dumps stay distinct
+            cap = self.capacity  # resize() mutates under the same lock
         payload = {
             "reason": reason,
             "dumped_at": time.time(),
             "pid": os.getpid(),
-            "capacity": self.capacity,
+            "capacity": cap,
             "records": snap,
         }
         stamp = time.strftime("%Y%m%dT%H%M%S", time.gmtime())
-        with self._lock:
-            self._dump_seq += 1
-            dump_n = self._dump_seq  # same-second same-reason dumps stay distinct
         path = os.path.join(
             d, f"flight-{stamp}-{reason}-{os.getpid()}-{dump_n}.json"
         )
@@ -139,6 +165,12 @@ class FlightRecorder:
 
 
 #: process-global recorder (importable singleton, like trace.metrics)
-flight = FlightRecorder(
-    capacity=int(os.environ.get("PHANT_FLIGHT_CAPACITY", str(_DEFAULT_CAPACITY)))
-)
+flight = FlightRecorder(capacity=_capacity_from_env())
+
+
+def refresh_from_env() -> None:
+    """Re-resolve the global ring's capacity from the environment (the
+    Engine API server calls this at construction, after the CLI wrote
+    `--flight-ring` into the env — the once-at-construction contract,
+    NOT re-read per record)."""
+    flight.resize(_capacity_from_env())

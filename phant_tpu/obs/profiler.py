@@ -93,6 +93,7 @@ def capture(seconds: float) -> dict:
         path = os.path.join(
             profile_dir(), f"profile-{stamp}-{os.getpid()}-{n}"
         )
+        t_start = time.time()
         try:
             os.makedirs(path, exist_ok=True)
             from phant_tpu.utils.trace import jax_profile
@@ -101,8 +102,14 @@ def capture(seconds: float) -> dict:
                 time.sleep(s)
         except Exception as e:
             raise ProfileError(f"profiler capture failed: {e!r}") from e
+        t_end = time.time()
         artifacts = sum(len(files) for _d, _sub, files in os.walk(path))
         flight.record("obs.profile", path=path, seconds=s, artifacts=artifacts)
+        # clock-sync marker: the capture window lands on the timeline's
+        # profiler track so the XLA device trace can be laid alongside
+        from phant_tpu.obs import timeline
+
+        timeline.record_profile(path, t_start, t_end)
         return {"path": path, "seconds": s, "artifacts": artifacts}
     finally:
         _inflight.release()

@@ -658,8 +658,12 @@ class MeshExecutorPool:
 
     def _finish(self, i: int, item: dict, verdicts, record: dict) -> None:
         record["device"] = i
-        if "pack_ms" in item:
-            record.setdefault("pack_ms", item["pack_ms"])
+        # stage timings measured on the lane thread ride the record so
+        # the timeline's batch sub-slices (and critpath's tiling) see
+        # the mesh path too — prefetch_ms used to be dropped here
+        for key in ("pack_ms", "prefetch_ms"):
+            if key in item:
+                record.setdefault(key, item[key])
         with self._lock:
             self._inflight_n[i] -= 1
             self._served[i] += 1

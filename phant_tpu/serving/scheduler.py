@@ -147,7 +147,7 @@ from typing import Callable, List, Optional, Sequence, Tuple
 
 import numpy as np
 
-from phant_tpu.obs import critpath
+from phant_tpu.obs import critpath, timeline
 from phant_tpu.obs.busy import BusyAccountant
 from phant_tpu.obs.flight import flight
 from phant_tpu.obs.watchdog import Watchdog
@@ -2316,6 +2316,14 @@ class VerificationScheduler:
             trace_ids=[j.trace_id for j in jobs],
             **record,
         )
+        # timeline tap: the [picked, done] interval lands on the lane's
+        # track, keyed by batch_id — the `f` side of the flow stitching
+        timeline.record_batch(
+            record,
+            lane=lane,
+            duration_ms=round((done - picked) * 1e3, 3),
+            trace_ids=[j.trace_id for j in jobs],
+        )
         metrics.observe_hist("sched.batch_size", n, buckets=_BATCH_BUCKETS)
         metrics.count("sched.batches", lane=lane)
         emit(n)
@@ -2547,6 +2555,14 @@ class VerificationScheduler:
             tenants=sorted(served),
             trace_ids=[j.trace_id for j in jobs],
             **record,
+        )
+        # timeline tap: every witness completion funnels here (inline,
+        # pipelined, mesh lane, megabatch) — one tap covers them all
+        timeline.record_batch(
+            record,
+            lane=_WITNESS,
+            duration_ms=round((done - picked) * 1e3, 3),
+            trace_ids=[j.trace_id for j in jobs],
         )
         metrics.observe_hist("sched.batch_size", n, buckets=_BATCH_BUCKETS)
         metrics.count("sched.batches", lane="witness")

@@ -1135,7 +1135,7 @@ def execute_stateless(
         block=block.header.block_number,
         nodes=len(nodes),
         codes=len(codes),
-    ):
+    ) as sp:
         try:
             # sender recovery dispatches FIRST (the sig lane,
             # ops/sig_engine.py): the merged device ecrecover computes
@@ -1199,6 +1199,10 @@ def execute_stateless(
         except Exception as e:
             # by-kind counter (bounded cardinality: exception class names)
             metrics.count("stateless.errors", kind=type(e).__name__)
+            # the span closes on the raise: stamp the failure on it so
+            # the sinks see it (the timeline tail-sampler keeps every
+            # crashed request — the -32052 postmortem must be in-ring)
+            sp.attrs["error"] = type(e).__name__
             # and an error record in the flight ring: a postmortem dump
             # carries the failing block + reason, not just a count
             from phant_tpu.obs.flight import flight

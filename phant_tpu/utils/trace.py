@@ -287,7 +287,11 @@ METRIC_HELP: Dict[str, str] = {
     "critpath.coverage_pct": "Cumulative attributed share of verify_block wall clock (the >=95% acceptance surface: anything lower means the phase tiling is missing a real cost)",
     "critpath.unattributed_pct": "Cumulative UNattributed share of verify_block wall clock (100 - coverage) — the honesty-check residual gauge",
     "critpath.requests": "verify_block spans rolled up by the critical-path attribution sink",
-    "obs.slow_captures": "Requests captured into the /debug/slow flight ring, by trigger (wall = --slo-budget-ms exceeded; a phase name = that phase's env budget exceeded)",
+    "obs.slow_captures": "Requests captured into the /debug/slow flight ring, by trigger (wall = --slo-budget-ms exceeded; near = landed in the top PHANT_SLO_NEAR_PCT of the budget, sampled; a phase name = that phase's env budget exceeded)",
+    # unified timeline export (phant_tpu/obs/timeline.py)
+    "obs.timeline_kept": "Requests kept by the timeline tail-sampler at span close, by reason (error = crashed request, slo = wall budget blown, p99 = rolling per-phase p99 exemplar, sample = uniform 1-in-N)",
+    "obs.timeline_dropped": "Requests dropped by the timeline tail-sampler, by reason (sampled_out = span-close decision — kept + sampled_out reconciles with offered load; ring_full = a previously-KEPT entry evicted by ring overflow, counted separately)",
+    "obs.timeline_exports": "Timeline exports rendered (GET /debug/timeline and the optional spool-to-dir copies)",
     # commitment schemes (phant_tpu/commitment/)
     "commitment.state_views": "Witness-backed state views constructed, by commitment scheme (mpt/binary) — the per-request scheme selector's audit trail",
     "commitment.witness_nodes": "Witness nodes generated by full-state witness collection (spec runner / differential harnesses), by scheme",
@@ -324,6 +328,7 @@ SPAN_HELP: Dict[str, str] = {
     "flight.dump": "A postmortem dump was written to disk (reason + path)",
     "obs.slow_capture": "A request blew its SLO budget (--slo-budget-ms wall clock, or a per-phase env override): carries the FULL span tree plus the critical-path breakdown — metrics say THAT it was slow, this exemplar says WHY (served at /debug/slow)",
     "obs.profile": "An on-demand TPU profiler capture ran (POST /debug/profile): carries the trace directory, the captured window, and the artifact count",
+    "obs.timeline_export": "A timeline export was rendered (GET /debug/timeline / spool): carries the window, event count, and how many requests/batches landed in it",
 }
 
 

@@ -124,6 +124,15 @@ def _direction(key: str) -> Optional[str]:
         # bar `obs_overhead_noise_aa_pct` stays informational, like every
         # other section's noise echo).
         return "down"
+    if key == "timeline_overhead_pct":
+        # timeline_overhead (round 16): the median paired recorder-on vs
+        # -off delta on the depth-2 serving path (attribution ON in both
+        # legs — the increment of the timeline layer alone) — GROWTH
+        # means the tail-sampled recorder is eating into serving
+        # throughput. Its A/A bar `timeline_overhead_noise_aa_pct` and
+        # the kept/offered reconciliation echoes stay informational
+        # (the reconciliation is asserted in-section, not trend-gated).
+        return "down"
     if key == "obs_overhead_coverage_pct":
         # the critical-path coverage claim (attributed share of request
         # wall clock, >= 95 asserted in-section): a SHRINKING value means

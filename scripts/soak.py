@@ -186,6 +186,9 @@ def main() -> int:
     rc = _slo_phase()
     if rc:
         return rc
+    rc = _timeline_phase()
+    if rc:
+        return rc
     return _qos_phase()
 
 
@@ -952,6 +955,154 @@ def _slo_phase() -> int:
         f"[soak] slo phase green: {slow_after - slow_before} violations "
         f"counted, {len(records)} exemplars in /debug/slow with stage-named "
         "phases, watchdog quiet"
+    )
+    return 0
+
+
+def _timeline_phase() -> int:
+    """Unified timeline export under live traffic (PR 16): a mixed-load
+    HTTP run against a server whose SLO budget is deliberately impossible
+    (every request violates) must export, over real HTTP, a PARSEABLE
+    Chrome-trace timeline whose kept-set contains the induced SLO
+    violators (`reason=slo`) with request AND lane tracks present and
+    every flow begin paired with its end; a second, throwaway poisoned
+    server's -32052 crash request must land in the kept-set with
+    `reason=error`; and the stall watchdog stays QUIET throughout."""
+    import json
+
+    from phant_tpu.engine_api.server import EngineAPIServer
+    from phant_tpu.obs import critpath, timeline
+    from phant_tpu.obs.flight import flight
+    from phant_tpu.serving import SchedulerConfig, VerificationScheduler
+
+    from test_serving import _post, _stateless_request
+
+    failures: list = []
+    n_requests = int(os.environ.get("PHANT_SOAK_TIMELINE_REQUESTS", "12"))
+    os.environ["PHANT_SLO_BUDGET_MS"] = "0.01"
+    seq_before = (flight.records() or [{}])[-1].get("seq", 0)
+    timeline.reset()
+    try:
+        stateless_chain, stateless_rpc, _want_root = _stateless_request()
+        server = EngineAPIServer(
+            stateless_chain,
+            host="127.0.0.1",
+            port=0,
+            sched_config=SchedulerConfig(
+                max_batch=8, max_wait_ms=5.0, queue_depth=256
+            ),
+        )
+        server.serve_in_background()
+        base = f"http://127.0.0.1:{server.port}"
+        try:
+            with ThreadPoolExecutor(max_workers=4) as pool:
+                for code, body in pool.map(
+                    lambda _i: _post(base, stateless_rpc), range(n_requests)
+                ):
+                    if code != 200 or body["result"]["status"] != "VALID":
+                        failures.append(f"stateless failed ({code}): {body}")
+            code, raw = _get(base, "/debug/timeline?window=300")
+            if code != 200:
+                failures.append(f"/debug/timeline HTTP {code}")
+                payload = {"traceEvents": [], "metadata": {}}
+            else:
+                payload = json.loads(raw)  # must be well-formed JSON
+        finally:
+            server.shutdown()
+    finally:
+        os.environ.pop("PHANT_SLO_BUDGET_MS", None)
+        critpath.refresh_from_env()
+
+    events = payload.get("traceEvents", [])
+    kept = payload.get("metadata", {}).get("kept", {})
+    if kept.get("slo", 0) < n_requests:
+        failures.append(
+            f"kept-set misses the induced SLO violators: {kept} "
+            f"(want slo >= {n_requests})"
+        )
+    slo_slices = [
+        e
+        for e in events
+        if e.get("ph") == "X"
+        and e.get("cat") == "request"
+        and e.get("args", {}).get("reason") == "slo"
+    ]
+    if len(slo_slices) < 1:
+        failures.append("no reason=slo request slice in the exported timeline")
+    proc_names = {
+        e["args"]["name"]
+        for e in events
+        if e.get("ph") == "M" and e.get("name") == "process_name"
+    }
+    if not {"requests", "lanes"} <= proc_names:
+        failures.append(f"track families missing from export: {proc_names}")
+    s_ids = {e["id"] for e in events if e.get("ph") == "s"}
+    f_ids = {e["id"] for e in events if e.get("ph") == "f"}
+    if s_ids != f_ids:
+        failures.append(f"unpaired flow events: {s_ids ^ f_ids}")
+    if not s_ids:
+        failures.append("no request->batch flow arrows in the exported timeline")
+
+    # crash request lands in the kept-set with reason=error: a throwaway
+    # poisoned server (same shape as _crash_phase, no dump assertions)
+    class _PoisonedEngine:
+        def verify_batch(self, witnesses):
+            raise RuntimeError("soak-induced timeline crash")
+
+    timeline.reset()
+    stateless_chain, stateless_rpc, _root = _stateless_request()
+    sched = VerificationScheduler(
+        engine=_PoisonedEngine(),
+        config=SchedulerConfig(max_batch=8, max_wait_ms=10.0),
+    )
+    server = EngineAPIServer(
+        stateless_chain, host="127.0.0.1", port=0, scheduler=sched
+    )
+    server.serve_in_background()
+    base = f"http://127.0.0.1:{server.port}"
+    try:
+        code, body = _post(base, stateless_rpc)
+        if code != 503 or body.get("error", {}).get("code") != -32052:
+            failures.append(f"induced crash reply unexpected: {code} {body}")
+        code, raw = _get(base, "/debug/timeline?window=300")
+        if code != 200:
+            failures.append(f"/debug/timeline post-crash HTTP {code}")
+            payload = {"traceEvents": [], "metadata": {}}
+        else:
+            payload = json.loads(raw)
+    finally:
+        server.shutdown()
+        sched.shutdown()
+    kept = payload.get("metadata", {}).get("kept", {})
+    if kept.get("error", 0) < 1:
+        failures.append(f"crash request not in the kept-set: {kept}")
+    crash_slices = [
+        e
+        for e in payload.get("traceEvents", [])
+        if e.get("ph") == "X"
+        and e.get("cat") == "request"
+        and e.get("args", {}).get("reason") == "error"
+    ]
+    if not crash_slices:
+        failures.append("no reason=error request slice after the crash")
+
+    # slow/crashed != stalled: the watchdog must not have fired
+    stalls = [
+        r
+        for r in flight.records()
+        if r.get("kind") == "sched.stall" and r.get("seq", 0) > seq_before
+    ]
+    if stalls:
+        failures.append(f"watchdog fired during the timeline phase: {stalls}")
+
+    if failures:
+        for f in failures:
+            print(f"[soak] FAIL (timeline phase): {f}", file=sys.stderr)
+        return 1
+    print(
+        f"[soak] timeline phase green: {len(slo_slices)} SLO violators + "
+        f"the crash request in the kept-set, {len(s_ids)} flow arrows "
+        "paired, tracks present, watchdog quiet"
     )
     return 0
 

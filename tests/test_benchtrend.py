@@ -537,3 +537,35 @@ def test_obs_overhead_regression_flags(tmp_path):
     rows, flags = benchtrend.analyze(str(tmp_path), 0.4, 2)
     assert any("obs_overhead_pct" in f for f in flags)
     assert any("obs_overhead_coverage_pct" in f for f in flags)
+
+
+def test_timeline_overhead_key_directions():
+    """Round-16 `timeline_overhead` section keys: the recorder-on/off
+    median paired overhead gates DOWN (growth = the tail-sampled
+    timeline layer eating serving throughput); the on/off serving rates
+    trend via `_per_sec`; the A/A noise bar and the kept/offered
+    reconciliation echoes (asserted in-section, not trend-gated) stay
+    informational. Pinned so a key rework cannot un-gate the PR 16
+    claim."""
+    d = benchtrend._direction
+    assert d("timeline_overhead_pct") == "down"
+    assert d("timeline_overhead_on_blocks_per_sec") == "up"
+    assert d("timeline_overhead_off_blocks_per_sec") == "up"
+    assert d("timeline_overhead_noise_aa_pct") is None
+    assert d("timeline_overhead_kept") is None
+    assert d("timeline_overhead_sampled_out") is None
+    assert d("timeline_overhead_offered") is None
+    assert d("timeline_overhead_reconciled") is None
+    assert d("timeline_overhead_sample_n") is None
+    assert d("timeline_overhead_verdict_identity") is None
+
+
+def test_timeline_overhead_blowup_flags(tmp_path):
+    """Timeline overhead blowing past its noise history must flag — the
+    committed claim is 'within the A/A bar', and a 10x growth is the
+    recorder silently landing on the serving hot path."""
+    for n, o in enumerate([1.9, 2.2, 1.7], start=1):
+        _write_round(tmp_path, n, {"timeline_overhead_pct": o})
+    _write_round(tmp_path, 4, {"timeline_overhead_pct": 24.0})
+    rows, flags = benchtrend.analyze(str(tmp_path), 0.4, 2)
+    assert any("timeline_overhead_pct" in f for f in flags)
