@@ -1,5 +1,5 @@
 # Commit gate (VERDICT r2 #4): `make check` must be green before a snapshot.
-.PHONY: check check-fast check-device native sanitize metrics-lint lint soak trend loadgen
+.PHONY: check check-fast check-device native sanitize sanitize-native sanitize-py metrics-lint lint soak trend loadgen
 
 check:
 	./scripts/check.sh
@@ -13,8 +13,14 @@ check:
 # the full package lints in ~2s. Intentional hazards carry inline
 # `# phantlint: disable=RULE — reason` annotations; anything grandfathered
 # lives in scripts/phantlint_baseline.json (currently EMPTY — keep it so).
+# scripts/ gets a second pass under the concurrency rules only — soak,
+# loadgen, and bench spawn threads too, but the JAX-hygiene rules don't
+# apply to host-side driver scripts.
 lint:
 	JAX_PLATFORMS=cpu python scripts/phantlint.py phant_tpu/ \
+	  --baseline scripts/phantlint_baseline.json
+	JAX_PLATFORMS=cpu python scripts/phantlint.py scripts/ \
+	  --rules LOCK,LOCKORDER,LOCKBLOCK,THREADSHARE \
 	  --baseline scripts/phantlint_baseline.json
 
 # Quick iteration subset (NOT a substitute for `make check` before commits):
@@ -33,15 +39,30 @@ check-device:
 native:
 	python -c "from phant_tpu.utils.native import build_native; print(build_native(verbose=True))"
 
-# ASan+UBSan run over the native runtime (known-answer vectors + RLP
-# scanner fuzz + ecrecover garbage inputs); SURVEY §5 sanitizers slot.
-sanitize:
+# Both halves of the dynamic-analysis surface (SURVEY §5 sanitizers
+# slot): ASan+UBSan over the native C++ runtime, then phantsan — the
+# Eraser-style lockset race detector (phant_tpu/analysis/sanitizer.py) —
+# over the Python serving path. check.sh additionally runs the full
+# serving group under PHANT_SANITIZE=1 at pipeline depth 2.
+sanitize: sanitize-native sanitize-py
+
+sanitize-native:
 	mkdir -p build
 	g++ -std=c++17 -O1 -g -fsanitize=address,undefined -fno-sanitize-recover=all \
 	  -Wall -Werror -Wno-maybe-uninitialized -o build/native_selftest \
 	  native/keccak.cc native/packer.cc native/secp256k1.cc native/engine.cc \
 	  native/selftest.cc
 	./build/native_selftest
+
+# Lockset-sanitized pytest subset: instrumented Lock/RLock proxies +
+# per-field lockset tracking on the registered shared classes; ANY
+# two-stack race report fails the session (tests/conftest.py
+# pytest_sessionfinish). Depth 2 keeps the pipelined pack/dispatch/
+# resolve overlap — the schedule phantsan has actually caught races in.
+sanitize-py:
+	PHANT_SANITIZE=1 PHANT_SCHED_PIPELINE_DEPTH=2 JAX_PLATFORMS=cpu \
+	  python -m pytest -q tests/test_sanitizer.py tests/test_serving.py \
+	  tests/test_post_root.py tests/test_sender_lane.py
 
 # Scheduler soak smoke (scripts/check.sh runs it after the pytest groups):
 # a live Engine API server on the CPU backend takes a few hundred

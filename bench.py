@@ -107,6 +107,14 @@ root. Verifiers measured on the SAME span:
     bar), verdict identity asserted per leg, and the in-section
     critical-path coverage assert (attributed phases >= 95% of wall
     clock — the residual gauge's honesty check).
+  * sanitizer_overhead (CPU section) — phantsan lockset-sanitizer cost
+    (round 17, analysis/sanitizer.py): the depth-2 serving path with
+    PHANT_SANITIZE-style instrumentation ON vs OFF (median paired delta
+    vs the same-statistic A/A noise bar). The overhead is the committed
+    price of the opt-in sanitized gate, NOT expected to sit within the
+    bar; in-section acceptance is verdict identity, ZERO race reports on
+    the pinned-clean scheduler, and the positive control (a deliberately
+    racy class must yield a two-stack report — the sanitizer works).
   * sender_lane (device section) — coalesced sender recovery (round 14,
     ops/sig_engine.py): sender byte-identity vs direct get_senders_batch
     asserted in-section (invalid-signature and pre-EIP-155 blocks
@@ -3400,6 +3408,158 @@ def sec_timeline_overhead() -> dict:
     return frag
 
 
+def sec_sanitizer_overhead() -> dict:
+    """phantsan lockset-sanitizer overhead (PR 17): what the sanitized
+    gate costs, so `make sanitize-py` and check.sh's serving_sanitized
+    group carry a committed price tag instead of folklore.
+
+    The depth-2 serving path (handler threads submitting witness jobs
+    through one pipelined VerificationScheduler) runs with phantsan ON
+    (instrumented Lock/RLock proxies + per-field lockset tracking on the
+    scheduler class, analysis/sanitizer.py) vs OFF. Statistics discipline
+    as in `obs_overhead`: MEDIAN of PAIRED interleaved on/off runs next
+    to a same-statistic A/A (on vs on) noise bar. Unlike the obs legs the
+    overhead is NOT expected to sit within the bar — it is the price of
+    opting in — so the committed claim is the honest number itself.
+    In-section the legs must prove the sanitizer WORKS and the path is
+    CLEAN: verdict identity (instrumentation may never change an answer),
+    zero race reports from the scheduler legs (the race-free gate this
+    bench rides on), and a positive control — a deliberately racy
+    unlocked counter class must produce a two-stack report, or the zero
+    above is the silence of a dead detector."""
+    import threading
+
+    from phant_tpu.analysis import sanitizer
+    from phant_tpu.ops.witness_engine import WitnessEngine
+    from phant_tpu.serving.scheduler import (
+        SchedulerConfig,
+        VerificationScheduler,
+    )
+
+    warm, chain = _witness_chain()
+    n = len(chain)
+    pairs = int(os.environ.get("PHANT_BENCH_OBS_PAIRS", "5"))
+    workers = int(os.environ.get("PHANT_BENCH_OBS_THREADS", "8"))
+    mb = int(os.environ.get("PHANT_BENCH_STREAM_BATCH", "16"))
+
+    eng = WitnessEngine()
+    wb = int(os.environ.get("PHANT_BENCH_ENGINE_BATCH", "256"))
+    for i in range(0, len(warm), wb):
+        assert eng.verify_batch(warm[i : i + wb]).all()
+    want = [bool(v) for v in eng.verify_batch(chain)]
+
+    # positive control FIRST: a two-thread unlocked counter on a
+    # registered class must produce a report, or every "zero races"
+    # number below is the silence of a dead detector
+    class _RacyControl:
+        def __init__(self):
+            self.hits = 0
+
+    sanitizer.enable()
+    sanitizer.register_shared_class(_RacyControl)
+    try:
+        ctl = _RacyControl()
+        gate = threading.Barrier(2)
+
+        def bump() -> None:
+            gate.wait()
+            for _ in range(64):
+                ctl.hits += 1
+
+        ts = [threading.Thread(target=bump) for _ in range(2)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        control = sanitizer.drain_reports()
+    finally:
+        sanitizer.unregister(_RacyControl)
+        sanitizer.disable()
+    assert control, "positive control: deliberate race produced no report"
+
+    def leg(sanitized: bool) -> float:
+        reports: list = []
+        if sanitized:
+            sanitizer.enable()
+            sanitizer.register_shared_class(VerificationScheduler)
+        try:
+            got: list = [None] * n
+            # constructed AFTER enable(): the scheduler's own locks must
+            # be proxies for the lockset tracking to see them held
+            with VerificationScheduler(
+                engine=eng,
+                config=SchedulerConfig(
+                    max_batch=mb,
+                    max_wait_ms=4.0,
+                    queue_depth=n + 1,
+                    pipeline_depth=2,
+                ),
+            ) as s:
+                pending = list(range(n))
+                plock = threading.Lock()
+
+                def drive() -> None:
+                    while True:
+                        with plock:
+                            if not pending:
+                                return
+                            i = pending.pop()
+                        root, nodes = chain[i]
+                        got[i] = s.submit_witness(root, nodes).result(
+                            timeout=300
+                        )
+
+                t0 = time.perf_counter()
+                threads = [
+                    threading.Thread(target=drive) for _ in range(workers)
+                ]
+                for t in threads:
+                    t.start()
+                for t in threads:
+                    t.join()
+                dt = time.perf_counter() - t0
+        finally:
+            if sanitized:
+                reports.extend(sanitizer.drain_reports())
+                sanitizer.unregister(VerificationScheduler)
+                sanitizer.disable()
+        assert got == want, "sanitizer instrumentation changed a verdict"
+        assert not reports, (
+            "sanitized serving leg raced:\n" + reports[0].format()
+        )
+        return dt
+
+    leg(True)  # warm the serving path (and the proxy classes); discarded
+    d_on: list = []
+    d_off: list = []
+    deltas: list = []
+    aa: list = []
+    for _ in range(pairs):
+        off = leg(False)
+        on = leg(True)
+        on2 = leg(True)  # the A/A twin measures the box, not the code
+        d_off.append(off)
+        d_on.append(on)
+        deltas.append(on / off - 1.0)
+        aa.append(abs(1.0 - on2 / on))
+    deltas.sort()
+    aa.sort()
+    frag = {
+        "sanitizer_overhead_blocks": n,
+        "sanitizer_overhead_pairs": pairs,
+        "sanitizer_overhead_workers": workers,
+        "sanitizer_overhead_off_blocks_per_sec": round(n / min(d_off), 2),
+        "sanitizer_overhead_on_blocks_per_sec": round(n / min(d_on), 2),
+        "sanitizer_overhead_pct": round(deltas[len(deltas) // 2] * 100, 2),
+        "sanitizer_overhead_noise_aa_pct": round(aa[len(aa) // 2] * 100, 2),
+        "sanitizer_overhead_reports": 0,  # the leg asserts would raise
+        "sanitizer_overhead_positive_control": len(control),
+        "sanitizer_overhead_verdict_identity": 1,  # leg asserts would raise
+    }
+    _bank(frag)
+    return frag
+
+
 # priority order matters: when the tunnel window is short, the headline
 # engine number and the GLV proof come first
 _CPU_SECTIONS = {
@@ -3409,6 +3569,7 @@ _CPU_SECTIONS = {
     "commitment_compare": sec_commitment_compare,
     "obs_overhead": sec_obs_overhead,
     "timeline_overhead": sec_timeline_overhead,
+    "sanitizer_overhead": sec_sanitizer_overhead,
     "replay": sec_replay_cpu,
     "state_root": sec_state_root_cpu,
     "ecrecover": sec_ecrecover_cpu,

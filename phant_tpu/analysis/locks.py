@@ -1,0 +1,453 @@
+"""Whole-program lock model for the concurrency rules (LOCKORDER/LOCKBLOCK).
+
+Resolves the project's lock *objects* — not names that merely contain
+"lock" — into stable lock ids, then walks every function tracking which
+locks are lexically held at each acquisition, call, and blocking
+operation:
+
+  * class-attribute locks: `self.<attr> = threading.Lock()/RLock()` in
+    `__init__` -> id `"<ClassQualname>.<attr>"`.  A
+    `threading.Condition(self.<lock>)` built over a known lock ALIASES it
+    (scheduler._cond wraps scheduler._lock — with either held, the same
+    mutex is held); a bare `threading.Condition()` owns a fresh RLock and
+    gets its own id.
+  * module-level locks: `NAME = threading.Lock()` at module top level ->
+    id `"<module>.<NAME>"`, resolvable through import aliases from other
+    modules.
+
+The per-function summaries under-approximate (an unresolvable context
+expr holds nothing; an unresolvable call resolves to no callee), which
+rules must translate into "may miss, never invents" findings.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from typing import Dict, FrozenSet, List, Optional, Set, Tuple
+
+from phant_tpu.analysis.symbols import (
+    ClassInfo,
+    FunctionInfo,
+    ModuleInfo,
+    Project,
+    _dotted,
+)
+
+_LOCK_CTORS = {
+    "threading.Lock": "lock",
+    "threading.RLock": "rlock",
+}
+_CONDITION_CTOR = "threading.Condition"
+
+
+def resolve_external(mi: ModuleInfo, dotted: str) -> str:
+    """Module-local dotted name -> fully-qualified external name through
+    the module's import aliases (`threading.Lock` for `Lock` after
+    `from threading import Lock`)."""
+    head, _, rest = dotted.partition(".")
+    target = mi.imports.get(head, head)
+    return target + ("." + rest if rest else "")
+
+
+@dataclass
+class LockDecl:
+    lock_id: str
+    kind: str  # "lock" | "rlock"
+    node: ast.AST
+    module: str
+
+
+@dataclass
+class FuncLockSummary:
+    """What one function does with locks, lexically."""
+
+    qualname: str
+    # (acquired lock id, with-item node, lock ids already held at that point)
+    acquisitions: List[Tuple[str, ast.AST, FrozenSet[str]]] = field(
+        default_factory=list
+    )
+    # (callee qualname, call node, lock ids held around the call)
+    calls: List[Tuple[str, ast.Call, FrozenSet[str]]] = field(default_factory=list)
+    # every call node with the held set (for rules with their own matchers)
+    call_nodes: List[Tuple[ast.Call, FrozenSet[str]]] = field(default_factory=list)
+
+
+class LockModel:
+    def __init__(self, project: Project):
+        self.project = project
+        # class qualname -> attr name -> LockDecl
+        self.class_locks: Dict[str, Dict[str, LockDecl]] = {}
+        # module name -> var name -> LockDecl
+        self.module_locks: Dict[str, Dict[str, LockDecl]] = {}
+        # module name -> local alias -> kind, for `_REAL_LOCK =
+        # threading.Lock` style ctor aliasing (the sanitizer itself must
+        # hold the real ctors while threading.Lock is patched, and its
+        # locks are no less locks for it)
+        self._ctor_aliases: Dict[str, Dict[str, str]] = {}
+        for mi in project.modules.values():
+            self._collect_ctor_aliases(mi)
+            self._collect_module_locks(mi)
+            for ci in mi.classes.values():
+                self._collect_class_locks(mi, ci)
+        self.summaries: Dict[str, FuncLockSummary] = {}
+        for mi in project.modules.values():
+            for fi in mi.functions.values():
+                self.summaries[fi.qualname] = self._summarize(mi, None, fi)
+            for ci in mi.classes.values():
+                for fi in ci.methods.values():
+                    self.summaries[fi.qualname] = self._summarize(mi, ci, fi)
+
+    # -- lock discovery ------------------------------------------------------
+
+    def _collect_ctor_aliases(self, mi: ModuleInfo) -> None:
+        table: Dict[str, str] = {}
+        for node in mi.tree.body:
+            if not (
+                isinstance(node, ast.Assign)
+                and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+                and isinstance(node.value, (ast.Name, ast.Attribute))
+            ):
+                continue
+            d = _dotted(node.value)
+            if d is None:
+                continue
+            kind = _LOCK_CTORS.get(resolve_external(mi, d), _LOCK_CTORS.get(d))
+            if kind is not None:
+                table[node.targets[0].id] = kind
+        if table:
+            self._ctor_aliases[mi.name] = table
+
+    def _lock_ctor_kind(self, mi: ModuleInfo, call: ast.Call) -> Optional[str]:
+        d = _dotted(call.func)
+        if d is None:
+            return None
+        full = resolve_external(mi, d)
+        kind = _LOCK_CTORS.get(full, _LOCK_CTORS.get(d))
+        if kind is None:
+            kind = self._ctor_aliases.get(mi.name, {}).get(d)
+        return kind
+
+    def _is_condition_ctor(self, mi: ModuleInfo, call: ast.Call) -> bool:
+        d = _dotted(call.func)
+        if d is None:
+            return False
+        return (
+            resolve_external(mi, d) == _CONDITION_CTOR or d == _CONDITION_CTOR
+        )
+
+    def _collect_module_locks(self, mi: ModuleInfo) -> None:
+        table: Dict[str, LockDecl] = {}
+        for node in mi.tree.body:
+            if not (
+                isinstance(node, ast.Assign)
+                and isinstance(node.value, ast.Call)
+                and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+            ):
+                continue
+            kind = self._lock_ctor_kind(mi, node.value)
+            name = node.targets[0].id
+            if kind is not None:
+                table[name] = LockDecl(
+                    lock_id=f"{mi.name}.{name}",
+                    kind=kind,
+                    node=node,
+                    module=mi.name,
+                )
+            elif self._is_condition_ctor(mi, node.value):
+                # Condition over a known module lock aliases it; a bare
+                # Condition() owns a fresh RLock
+                decl = None
+                if node.value.args:
+                    arg = node.value.args[0]
+                    if isinstance(arg, ast.Name):
+                        decl = table.get(arg.id)
+                if decl is not None:
+                    table[name] = LockDecl(
+                        lock_id=decl.lock_id,
+                        kind=decl.kind,
+                        node=node,
+                        module=mi.name,
+                    )
+                else:
+                    table[name] = LockDecl(
+                        lock_id=f"{mi.name}.{name}",
+                        kind="rlock",
+                        node=node,
+                        module=mi.name,
+                    )
+        if table:
+            self.module_locks[mi.name] = table
+
+    def _collect_class_locks(self, mi: ModuleInfo, ci: ClassInfo) -> None:
+        init = ci.methods.get("__init__")
+        if init is None:
+            return
+        table: Dict[str, LockDecl] = {}
+        for node in ast.walk(init.node):
+            if not (
+                isinstance(node, ast.Assign) and isinstance(node.value, ast.Call)
+            ):
+                continue
+            tgt = node.targets[0] if len(node.targets) == 1 else None
+            if not (
+                isinstance(tgt, ast.Attribute)
+                and isinstance(tgt.value, ast.Name)
+                and tgt.value.id == "self"
+            ):
+                continue
+            kind = self._lock_ctor_kind(mi, node.value)
+            if kind is not None:
+                table[tgt.attr] = LockDecl(
+                    lock_id=f"{ci.qualname}.{tgt.attr}",
+                    kind=kind,
+                    node=node,
+                    module=mi.name,
+                )
+                continue
+            if self._is_condition_ctor(mi, node.value):
+                decl = None
+                if node.value.args:
+                    arg = node.value.args[0]
+                    if (
+                        isinstance(arg, ast.Attribute)
+                        and isinstance(arg.value, ast.Name)
+                        and arg.value.id == "self"
+                    ):
+                        decl = table.get(arg.attr)
+                if decl is not None:
+                    table[tgt.attr] = LockDecl(
+                        lock_id=decl.lock_id,
+                        kind=decl.kind,
+                        node=node,
+                        module=mi.name,
+                    )
+                else:
+                    table[tgt.attr] = LockDecl(
+                        lock_id=f"{ci.qualname}.{tgt.attr}",
+                        kind="rlock",
+                        node=node,
+                        module=mi.name,
+                    )
+        if table:
+            self.class_locks[ci.qualname] = table
+
+    def class_lock_decls(self, ci: ClassInfo) -> Dict[str, LockDecl]:
+        """Lock attrs of a class including inherited ones (base walk)."""
+        out: Dict[str, LockDecl] = {}
+        seen: Set[str] = set()
+        stack = [ci]
+        while stack:
+            c = stack.pop()
+            if c.qualname in seen:
+                continue
+            seen.add(c.qualname)
+            for attr, decl in self.class_locks.get(c.qualname, {}).items():
+                out.setdefault(attr, decl)
+            for b in c.base_names:
+                base = self.project.resolve_class(c.module, b)
+                if base is not None:
+                    stack.append(base)
+        return out
+
+    # -- context-expr resolution ----------------------------------------------
+
+    def resolve_lock_expr(
+        self,
+        mi: ModuleInfo,
+        owner: Optional[ClassInfo],
+        expr: ast.AST,
+        self_names: FrozenSet[str] = frozenset({"self"}),
+    ) -> Optional[LockDecl]:
+        """`with <expr>:` -> the LockDecl it holds, or None if it is not a
+        resolvable lock object."""
+        # bare NAME: module-level lock, local or imported
+        if isinstance(expr, ast.Name):
+            decl = self.module_locks.get(mi.name, {}).get(expr.id)
+            if decl is not None:
+                return decl
+            target = mi.imports.get(expr.id)
+            if target and "." in target:
+                mod, _, var = target.rpartition(".")
+                return self.module_locks.get(mod, {}).get(var)
+            return None
+        if not isinstance(expr, ast.Attribute):
+            return None
+        base = expr.value
+        # self.X / outer.X (self-alias)
+        if isinstance(base, ast.Name):
+            if base.id in self_names and owner is not None:
+                return self.class_lock_decls(owner).get(expr.attr)
+            # module_alias.NAME
+            mod = mi.imports.get(base.id)
+            if mod is not None:
+                decl = self.module_locks.get(mod, {}).get(expr.attr)
+                if decl is not None:
+                    return decl
+            # var.X where var is ctor-typed is handled by the caller
+            # passing owner=var's class through `resolve_lock_attr`
+            return None
+        # self.attr.X: stored-attribute class's lock
+        if (
+            isinstance(base, ast.Attribute)
+            and isinstance(base.value, ast.Name)
+            and base.value.id in self_names
+            and owner is not None
+        ):
+            for holder in self.project.attr_classes_of(owner, base.attr):
+                decl = self.class_lock_decls(holder).get(expr.attr)
+                if decl is not None:
+                    return decl
+        return None
+
+    # -- per-function summaries ------------------------------------------------
+
+    def _self_aliases(self, ci: Optional[ClassInfo]) -> FrozenSet[str]:
+        names = {"self"}
+        if ci is not None:
+            init = ci.methods.get("__init__")
+            if init is not None:
+                for node in ast.walk(init.node):
+                    if (
+                        isinstance(node, ast.Assign)
+                        and isinstance(node.value, ast.Name)
+                        and node.value.id == "self"
+                    ):
+                        for tgt in node.targets:
+                            if isinstance(tgt, ast.Name):
+                                names.add(tgt.id)
+        return frozenset(names)
+
+    def lock_resolver(
+        self, mi: ModuleInfo, owner: Optional[ClassInfo], fi: FunctionInfo
+    ):
+        """expr -> Optional[LockDecl] with full resolution for one function:
+        self/alias attrs, module locks (local or imported), and lock attrs
+        of ctor-typed locals.  The same resolution _summarize uses; exposed
+        so LOCK's L2 check resolves actual lock objects instead of matching
+        "lock" in the context-expr text."""
+        self_names = self._self_aliases(owner)
+        var_classes = self.project.ctor_typed_locals(mi, fi)
+
+        def lock_of(expr: ast.AST) -> Optional[LockDecl]:
+            decl = self.resolve_lock_expr(mi, owner, expr, self_names)
+            if decl is not None:
+                return decl
+            # var.X where var holds a ctor-typed instance
+            if (
+                isinstance(expr, ast.Attribute)
+                and isinstance(expr.value, ast.Name)
+                and expr.value.id in var_classes
+            ):
+                return self.class_lock_decls(var_classes[expr.value.id]).get(
+                    expr.attr
+                )
+            return None
+
+        return lock_of
+
+    def _summarize(
+        self, mi: ModuleInfo, owner: Optional[ClassInfo], fi: FunctionInfo
+    ) -> FuncLockSummary:
+        summary = FuncLockSummary(qualname=fi.qualname)
+        lock_of = self.lock_resolver(mi, owner, fi)
+        var_classes = self.project.ctor_typed_locals(mi, fi)
+
+        def visit_expr(expr: ast.AST, held: FrozenSet[str]) -> None:
+            for node in ast.walk(expr):
+                if isinstance(node, ast.Call):
+                    summary.call_nodes.append((node, held))
+                    for callee in self.project.callees_of(
+                        mi, owner, node, var_classes
+                    ):
+                        summary.calls.append((callee, node, held))
+
+        def visit_stmts(stmts: List[ast.stmt], held: FrozenSet[str]) -> None:
+            for stmt in stmts:
+                visit_stmt(stmt, held)
+
+        def visit_stmt(stmt: ast.stmt, held: FrozenSet[str]) -> None:
+            if isinstance(stmt, (ast.With, ast.AsyncWith)):
+                inner = set(held)
+                for item in stmt.items:
+                    visit_expr(item.context_expr, held)
+                    decl = lock_of(item.context_expr)
+                    if decl is not None:
+                        summary.acquisitions.append(
+                            (decl.lock_id, item.context_expr, frozenset(inner))
+                        )
+                        inner.add(decl.lock_id)
+                visit_stmts(stmt.body, frozenset(inner))
+                return
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                # nested def: its BODY runs later, not under the current
+                # locks — walk it with an empty held set (decorators and
+                # defaults evaluate here, under the current set)
+                for dec in stmt.decorator_list:
+                    visit_expr(dec, held)
+                for default in list(stmt.args.defaults) + [
+                    d for d in stmt.args.kw_defaults if d is not None
+                ]:
+                    visit_expr(default, held)
+                visit_stmts(stmt.body, frozenset())
+                return
+            if isinstance(stmt, ast.ClassDef):
+                visit_stmts(
+                    [s for s in stmt.body if isinstance(s, ast.stmt)], held
+                )
+                return
+            for child in ast.iter_child_nodes(stmt):
+                if isinstance(child, ast.stmt):
+                    visit_stmt(child, held)
+                elif isinstance(child, ast.expr):
+                    visit_expr(child, held)
+                elif isinstance(child, ast.ExceptHandler):
+                    visit_stmts(child.body, held)
+                elif isinstance(child, getattr(ast, "match_case", ())):
+                    if child.guard is not None:
+                        visit_expr(child.guard, held)
+                    visit_stmts(child.body, held)
+
+        visit_stmts(fi.node.body, frozenset())
+        return summary
+
+    # -- interprocedural closures ---------------------------------------------
+
+    def acquired_closure(self) -> Dict[str, Set[str]]:
+        """qualname -> every lock id acquired anywhere in its transitive
+        call closure (including its own lexical acquisitions)."""
+        direct: Dict[str, Set[str]] = {
+            q: {lid for lid, _, _ in s.acquisitions}
+            for q, s in self.summaries.items()
+        }
+        return _transitive(direct, self.project.call_graph)
+
+
+def lock_model(project: Project) -> LockModel:
+    """Per-Project LockModel memo: LOCK/LOCKORDER/LOCKBLOCK/THREADSHARE all
+    consume the same model, and building it walks every function."""
+    model = getattr(project, "_phantlint_lock_model", None)
+    if model is None or model.project is not project:
+        model = LockModel(project)
+        project._phantlint_lock_model = model
+    return model
+
+
+def _transitive(
+    direct: Dict[str, Set[str]], call_graph: Dict[str, Set[str]]
+) -> Dict[str, Set[str]]:
+    """Fixed point of `out[f] = direct[f] | union(out[g] for g in calls[f])`."""
+    out = {q: set(v) for q, v in direct.items()}
+    changed = True
+    while changed:
+        changed = False
+        for q, callees in call_graph.items():
+            acc = out.setdefault(q, set())
+            before = len(acc)
+            for g in callees:
+                acc |= out.get(g, set())
+            if len(acc) != before:
+                changed = True
+    return out

@@ -15,8 +15,11 @@ from phant_tpu.analysis.rules.hostsync import HostSyncRule
 from phant_tpu.analysis.rules.jithygiene import JitHygieneRule
 from phant_tpu.analysis.rules.jnphostloop import JnpHostLoopRule
 from phant_tpu.analysis.rules.lock import LockRule
+from phant_tpu.analysis.rules.lockblock import LockBlockRule
+from phant_tpu.analysis.rules.lockorder import LockOrderRule
 from phant_tpu.analysis.rules.metricname import MetricNameRule
 from phant_tpu.analysis.rules.spanname import SpanNameRule
+from phant_tpu.analysis.rules.threadshare import ThreadShareRule
 
 ALL_RULES = [
     HostSyncRule,
@@ -24,6 +27,9 @@ ALL_RULES = [
     JitHygieneRule,
     JnpHostLoopRule,
     LockRule,
+    LockOrderRule,
+    LockBlockRule,
+    ThreadShareRule,
     MetricNameRule,
     SpanNameRule,
 ]

@@ -37,6 +37,7 @@ from dataclasses import dataclass
 from typing import Dict, Iterator, List, Optional, Set, Tuple
 
 from phant_tpu.analysis.core import Finding, Rule
+from phant_tpu.analysis.locks import lock_model
 from phant_tpu.analysis.symbols import ClassInfo, ModuleInfo, Project, _dotted
 
 _LOCK_CTORS = ("threading.Lock", "threading.RLock")
@@ -249,10 +250,13 @@ class LockRule(Rule):
     # -- L2 ------------------------------------------------------------------
 
     def _check_lazy_init(self, project: Project, mi: ModuleInfo) -> Iterator[Finding]:
-        funcs = list(mi.functions.values())
+        model = lock_model(project)
+        funcs: List[Tuple[Optional[ClassInfo], object]] = [
+            (None, fi) for fi in mi.functions.values()
+        ]
         for ci in mi.classes.values():
-            funcs.extend(ci.methods.values())
-        for fi in funcs:
+            funcs.extend((ci, fi) for fi in ci.methods.values())
+        for owner, fi in funcs:
             if fi.node.name.endswith("_locked"):
                 continue  # documented "caller holds the lock" convention
             globals_declared: Set[str] = set()
@@ -264,7 +268,8 @@ class LockRule(Rule):
             tested = self._tested_globals(fi.node, globals_declared)
             if not tested:
                 continue
-            for name, node in self._unlocked_stores(fi.node, tested):
+            lock_of = model.lock_resolver(mi, owner, fi)
+            for name, node in self._unlocked_stores(fi.node, tested, lock_of):
                 yield self.finding(
                     project,
                     mi,
@@ -285,17 +290,19 @@ class LockRule(Rule):
                         out.add(n.id)
         return out
 
-    def _unlocked_stores(self, fn: ast.AST, names: Set[str]):
+    def _unlocked_stores(self, fn: ast.AST, names: Set[str], lock_of):
         """(name, node) for the FIRST assignment to each of `names` outside
-        any with-lock block (one finding per global per function)."""
+        any with-lock block (one finding per global per function).
+        `lock_of` is LockModel.lock_resolver's predicate: a with-item
+        counts as a lock only if it resolves to an actual Lock/RLock
+        object — a context manager merely NAMED "…lock…" does not."""
         seen: Set[str] = set()
 
         def walk(stmts, locked):
             for stmt in stmts:
                 if isinstance(stmt, ast.With):
                     inner = locked or any(
-                        "lock" in (_dotted(i.context_expr) or "").lower()
-                        for i in stmt.items
+                        lock_of(i.context_expr) is not None for i in stmt.items
                     )
                     yield from walk(stmt.body, inner)
                     continue

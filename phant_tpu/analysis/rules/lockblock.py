@@ -1,0 +1,252 @@
+"""LOCKBLOCK: blocking operations inside a `with <lock>` region.
+
+A lock on the serving path bounds every other thread's latency by the
+longest critical section — a blocking call inside one turns a mutex into
+a convoy (and, against the scheduler's own worker, into a deadlock when
+the blocked-on work needs the same lock to finish).  Flagged operations:
+
+  * `queue.Queue/SimpleQueue/LifoQueue/PriorityQueue` `.get()/.put()` on
+    receivers whose constructor is visible (stored attrs or locals);
+  * `Future.result()` — waits for another thread, which may need the lock;
+  * `block_until_ready()` / `jax.device_get()` — device sync can be a full
+    dispatch+transfer round trip;
+  * `time.sleep`;
+  * `Thread.join()` (ctor-typed receivers, plus `*thread*`-named attrs —
+    `"sep".join(...)` never matches: a string receiver is not thread-named);
+  * socket/HTTP sends: `sendall/recv/accept/getresponse`,
+    `urllib.request.urlopen`, `subprocess` waits (`run/check_call/
+    check_output/communicate`).
+
+`.wait()` is exempt: `Condition.wait` RELEASES the lock while waiting —
+that is the one blocking-under-lock shape that is correct by design.
+(`Event.wait` under a lock would be a real bug this exemption hides; the
+codebase convention is Condition, and phantsan exists to catch the rest.)
+
+Interprocedural: calling a function whose transitive closure contains a
+blocking op, while holding a lock, is flagged at the call site naming the
+inner operation — the lock-held path to a blocking call is the bug, not
+just the lexical nesting.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List, Optional, Set, Tuple
+
+from phant_tpu.analysis.core import Finding, Rule
+from phant_tpu.analysis.locks import LockModel, lock_model, _transitive, resolve_external
+from phant_tpu.analysis.symbols import (
+    ClassInfo,
+    FunctionInfo,
+    ModuleInfo,
+    Project,
+    _dotted,
+)
+
+_QUEUE_CTORS = {
+    "queue.Queue",
+    "queue.SimpleQueue",
+    "queue.LifoQueue",
+    "queue.PriorityQueue",
+}
+_THREAD_CTOR = "threading.Thread"
+_BLOCKING_EXTERNALS = {
+    "time.sleep": "time.sleep()",
+    "jax.device_get": "jax.device_get()",
+    "urllib.request.urlopen": "urllib.request.urlopen()",
+    "subprocess.run": "subprocess.run()",
+    "subprocess.check_call": "subprocess.check_call()",
+    "subprocess.check_output": "subprocess.check_output()",
+    "os.system": "os.system()",
+}
+_BLOCKING_METHODS = {
+    "result": "Future.result()",
+    "block_until_ready": "block_until_ready() device sync",
+    "sendall": "socket sendall()",
+    "recv": "socket recv()",
+    "accept": "socket accept()",
+    "getresponse": "HTTP getresponse()",
+    "communicate": "subprocess communicate()",
+}
+_QUEUE_METHODS = {"get": "queue get()", "put": "queue put()"}
+
+
+class LockBlockRule(Rule):
+    name = "LOCKBLOCK"
+    description = "blocking operation while holding a lock"
+
+    def run(self, project: Project) -> Iterator[Finding]:
+        model = lock_model(project)
+        # per-function direct blocking ops (regardless of local lock state —
+        # the caller's held set is what matters interprocedurally)
+        direct_ops: Dict[str, Set[str]] = {}
+        sites: List[Tuple[str, ModuleInfo, ast.Call, str, frozenset]] = []
+        for mi in project.modules.values():
+            funcs: List[Tuple[Optional[ClassInfo], FunctionInfo]] = [
+                (None, fi) for fi in mi.functions.values()
+            ]
+            for ci in mi.classes.values():
+                funcs.extend((ci, fi) for fi in ci.methods.values())
+            for ci, fi in funcs:
+                summary = model.summaries[fi.qualname]
+                queue_attrs, thread_attrs = self._typed_attrs(project, mi, ci)
+                var_queues, var_threads = self._typed_locals(mi, fi)
+                ops: Set[str] = set()
+                for call, held in summary.call_nodes:
+                    desc = self._blocking_desc(
+                        mi,
+                        call,
+                        queue_attrs,
+                        thread_attrs,
+                        var_queues,
+                        var_threads,
+                    )
+                    if desc is None:
+                        continue
+                    if held:
+                        # guarded at its own site: reported once, directly;
+                        # NOT propagated to callers (the callee's author
+                        # already made a locking decision there — the one
+                        # finding is where the prose waiver belongs, not
+                        # every transitive caller of a memoized builder)
+                        sites.append((fi.qualname, mi, call, desc, held))
+                    else:
+                        ops.add(desc)
+                direct_ops[fi.qualname] = ops
+
+        # direct findings: the op itself sits under a lock
+        direct_nodes = {id(call) for _, _, call, _, _ in sites}
+        for qualname, mi, call, desc, held in sites:
+            yield self.finding(
+                project,
+                mi,
+                call,
+                f"blocking {desc} while holding "
+                + ", ".join(f"`{l}`" for l in sorted(held))
+                + " — every waiter on the lock now waits on this too; move "
+                "the blocking call outside the critical section",
+                context=qualname,
+            )
+
+        # interprocedural: a lock-held call whose closure blocks. The
+        # closure flows only through LOCK-FREE call edges: if g calls h
+        # under a lock of its own, that site is g's finding (or g's prose
+        # waiver) — g is the decision point, and re-flagging every caller
+        # of g would turn one waived one-time-build into a file of noise.
+        unlocked_calls: Dict[str, Set[str]] = {
+            q: {callee for callee, _, held in s.calls if not held}
+            for q, s in model.summaries.items()
+        }
+        closure = _transitive(direct_ops, unlocked_calls)
+        for q, summary in model.summaries.items():
+            mi = project.module_of(q)
+            if mi is None:
+                continue
+            reported: Set[int] = set()
+            for callee, node, held in summary.calls:
+                if not held or id(node) in reported or id(node) in direct_nodes:
+                    continue
+                inner = closure.get(callee, set())
+                if not inner:
+                    continue
+                reported.add(id(node))
+                sample = sorted(inner)[0]
+                yield self.finding(
+                    project,
+                    mi,
+                    node,
+                    f"call into {callee}() may block ({sample}"
+                    + (f" +{len(inner) - 1} more" if len(inner) > 1 else "")
+                    + ") while holding "
+                    + ", ".join(f"`{l}`" for l in sorted(held)),
+                    context=q,
+                )
+
+    # ------------------------------------------------------------------
+
+    @staticmethod
+    def _typed_attrs(
+        project: Project, mi: ModuleInfo, ci: Optional[ClassInfo]
+    ) -> Tuple[Set[str], Set[str]]:
+        """self-attrs whose recorded ctor is a queue / a Thread."""
+        queues: Set[str] = set()
+        threads: Set[str] = set()
+        if ci is None:
+            return queues, threads
+        for attr, ctors in ci.attr_ctor_names.items():
+            for d in ctors:
+                full = resolve_external(mi, d)
+                if full in _QUEUE_CTORS:
+                    queues.add(attr)
+                elif full == _THREAD_CTOR:
+                    threads.add(attr)
+        return queues, threads
+
+    @staticmethod
+    def _typed_locals(
+        mi: ModuleInfo, fi: FunctionInfo
+    ) -> Tuple[Set[str], Set[str]]:
+        queues: Set[str] = set()
+        threads: Set[str] = set()
+        for node in ast.walk(fi.node):
+            if not (
+                isinstance(node, ast.Assign) and isinstance(node.value, ast.Call)
+            ):
+                continue
+            d = _dotted(node.value.func)
+            if d is None:
+                continue
+            full = resolve_external(mi, d)
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name):
+                    if full in _QUEUE_CTORS:
+                        queues.add(tgt.id)
+                    elif full == _THREAD_CTOR:
+                        threads.add(tgt.id)
+        return queues, threads
+
+    def _blocking_desc(
+        self,
+        mi: ModuleInfo,
+        call: ast.Call,
+        queue_attrs: Set[str],
+        thread_attrs: Set[str],
+        var_queues: Set[str],
+        var_threads: Set[str],
+    ) -> Optional[str]:
+        func = call.func
+        d = _dotted(func)
+        if d is not None:
+            full = resolve_external(mi, d)
+            if full in _BLOCKING_EXTERNALS:
+                return _BLOCKING_EXTERNALS[full]
+        if not isinstance(func, ast.Attribute):
+            return None
+        attr = func.attr
+        recv = func.value
+
+        def recv_in(attrs: Set[str], local_vars: Set[str]) -> bool:
+            if isinstance(recv, ast.Name):
+                return recv.id in local_vars
+            if (
+                isinstance(recv, ast.Attribute)
+                and isinstance(recv.value, ast.Name)
+                and recv.value.id == "self"
+            ):
+                return recv.attr in attrs
+            return False
+
+        if attr in _QUEUE_METHODS:
+            if recv_in(queue_attrs, var_queues):
+                return _QUEUE_METHODS[attr]
+            return None
+        if attr == "join":
+            if recv_in(thread_attrs, var_threads):
+                return "Thread.join()"
+            rd = _dotted(recv)
+            if rd is not None and "thread" in rd.lower():
+                return "Thread.join()"
+            return None
+        if attr in _BLOCKING_METHODS:
+            return _BLOCKING_METHODS[attr]
+        return None

@@ -1,0 +1,174 @@
+"""LOCKORDER: lock-acquisition order cycles across the whole program.
+
+Builds the global lock-acquisition graph over the call graph: an edge
+A -> B means some function acquires lock B (a `with` on a resolved lock
+object, locks.py) while already holding lock A — either lexically nested
+in one function, or because a function called with A held transitively
+acquires B.  A cycle in that graph is a potential deadlock: two threads
+entering the cycle from different edges can each hold the lock the other
+needs.  Also flags the degenerate one-lock case — re-acquiring a
+non-reentrant `threading.Lock` lexically inside its own `with` block —
+which deadlocks a single thread with itself.
+
+Interprocedural edges deliberately skip the A -> A case: the static lock
+id conflates instances (`a._lock` and `b._lock` of the same class share
+one id), so "holds `C._lock`, calls a method that takes `C._lock`" is
+routinely two different instances.  The lexical same-expression case has
+no such excuse and is reported.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List, Set, Tuple
+
+from phant_tpu.analysis.core import Finding, Rule, rel_path
+from phant_tpu.analysis.locks import LockModel, lock_model
+from phant_tpu.analysis.symbols import Project
+
+# witness for one graph edge: (holder, acquired) proven at a site
+_Edge = Tuple[str, str]
+
+
+class LockOrderRule(Rule):
+    name = "LOCKORDER"
+    description = "lock-acquisition order cycles (potential deadlocks)"
+
+    def run(self, project: Project) -> Iterator[Finding]:
+        model = lock_model(project)
+        closure = model.acquired_closure()
+        lock_kinds = self._lock_kinds(model)
+        edges: Dict[_Edge, Tuple[str, ast.AST, str]] = {}  # -> (qualname, node, path)
+
+        def add_edge(a: str, b: str, qualname: str, node: ast.AST) -> None:
+            mi = project.module_of(qualname)
+            if mi is None:
+                return
+            path = rel_path(mi.path)
+            prev = edges.get((a, b))
+            key = (path, getattr(node, "lineno", 0))
+            if prev is None or key < (prev[2], getattr(prev[1], "lineno", 0)):
+                edges[(a, b)] = (qualname, node, path)
+
+        for q, summary in model.summaries.items():
+            for lock_id, node, held in summary.acquisitions:
+                for h in held:
+                    if h != lock_id:
+                        add_edge(h, lock_id, q, node)
+                if lock_id in held and lock_kinds.get(lock_id) == "lock":
+                    mi = project.module_of(q)
+                    if mi is not None:
+                        yield self.finding(
+                            project,
+                            mi,
+                            node,
+                            f"re-acquiring non-reentrant lock `{lock_id}` "
+                            "inside its own `with` block — this deadlocks "
+                            "the acquiring thread with itself",
+                            context=q,
+                        )
+            for callee, node, held in summary.calls:
+                if not held:
+                    continue
+                for inner in closure.get(callee, ()):
+                    for h in held:
+                        if h != inner:
+                            add_edge(h, inner, q, node)
+
+        yield from self._cycle_findings(project, edges)
+
+    # ------------------------------------------------------------------
+
+    @staticmethod
+    def _lock_kinds(model: LockModel) -> Dict[str, str]:
+        kinds: Dict[str, str] = {}
+        for table in list(model.class_locks.values()) + list(
+            model.module_locks.values()
+        ):
+            for decl in table.values():
+                kinds.setdefault(decl.lock_id, decl.kind)
+        return kinds
+
+    def _cycle_findings(
+        self, project: Project, edges: Dict[_Edge, Tuple[str, ast.AST, str]]
+    ) -> Iterator[Finding]:
+        adj: Dict[str, Set[str]] = {}
+        for a, b in edges:
+            adj.setdefault(a, set()).add(b)
+            adj.setdefault(b, set())
+        for scc in _sccs(adj):
+            if len(scc) < 2:
+                continue
+            cyc_edges = sorted(
+                (a, b) for (a, b) in edges if a in scc and b in scc
+            )
+            witnesses = []
+            for a, b in cyc_edges:
+                qualname, node, path = edges[(a, b)]
+                witnesses.append(
+                    f"`{a}` held while acquiring `{b}` in {qualname}() "
+                    f"({path}:{getattr(node, 'lineno', '?')})"
+                )
+            first_q, first_node, _ = edges[cyc_edges[0]]
+            mi = project.module_of(first_q)
+            if mi is None:
+                continue
+            yield self.finding(
+                project,
+                mi,
+                first_node,
+                "lock-order cycle among "
+                + ", ".join(f"`{l}`" for l in sorted(scc))
+                + " — potential cross-thread deadlock: "
+                + "; ".join(witnesses),
+                context=first_q,
+            )
+
+
+def _sccs(adj: Dict[str, Set[str]]) -> List[Set[str]]:
+    """Tarjan's strongly connected components, iteratively."""
+    index: Dict[str, int] = {}
+    low: Dict[str, int] = {}
+    on_stack: Set[str] = set()
+    stack: List[str] = []
+    out: List[Set[str]] = []
+    counter = [0]
+
+    for root in sorted(adj):
+        if root in index:
+            continue
+        work: List[Tuple[str, int]] = [(root, 0)]
+        while work:
+            node, pi = work[-1]
+            if pi == 0:
+                index[node] = low[node] = counter[0]
+                counter[0] += 1
+                stack.append(node)
+                on_stack.add(node)
+            recursed = False
+            succs = sorted(adj.get(node, ()))
+            for i in range(pi, len(succs)):
+                w = succs[i]
+                if w not in index:
+                    work[-1] = (node, i + 1)
+                    work.append((w, 0))
+                    recursed = True
+                    break
+                if w in on_stack:
+                    low[node] = min(low[node], index[w])
+            if recursed:
+                continue
+            work.pop()
+            if work:
+                parent = work[-1][0]
+                low[parent] = min(low[parent], low[node])
+            if low[node] == index[node]:
+                comp: Set[str] = set()
+                while True:
+                    w = stack.pop()
+                    on_stack.discard(w)
+                    comp.add(w)
+                    if w == node:
+                        break
+                out.append(comp)
+    return out

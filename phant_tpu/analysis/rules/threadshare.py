@@ -1,0 +1,198 @@
+"""THREADSHARE: thread-shared classes that own no lock.
+
+Escape analysis extending LOCK from "classes that HAVE a lock use it
+consistently" to "classes that SHOULD have one do": a class instance is
+*thread-shared* once it is reachable from more than one thread —
+
+  * `threading.Thread(target=self.m)` inside a class: the instance runs a
+    worker, so every attribute is visible to (at least) the spawning
+    thread and the worker;
+  * `threading.Thread(target=f, args=(obj, ...))` with a ctor-typed obj:
+    the object crosses into the thread;
+  * `NAME = Ctor(...)` at module level: a published singleton — every
+    importing thread shares the one instance (obs.flight.flight,
+    utils.trace.metrics).
+
+A shared class with post-`__init__` attribute mutation and no
+`threading.Lock/RLock` attr (own or inherited) is flagged.  Waivers:
+
+  * `# phantlint: immutable` on the class-def line or the line directly
+    above — the author asserts all post-init state is read-only or
+    benignly monotonic (phantsan validates the claim at runtime);
+  * the ordinary `# phantlint: disable=THREADSHARE` suppression.
+
+Under-approximation: sharing through containers, callbacks, or factory
+returns is invisible here — phantsan (analysis/sanitizer.py) is the
+dynamic backstop for those.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Iterator, Optional, Set
+
+from phant_tpu.analysis.core import Finding, Rule
+from phant_tpu.analysis.locks import LockModel, lock_model, resolve_external
+from phant_tpu.analysis.symbols import ClassInfo, ModuleInfo, Project, _dotted
+
+_THREAD_CTOR = "threading.Thread"
+_IMMUTABLE_RE = re.compile(r"#\s*phantlint:\s*immutable\b")
+
+
+class ThreadShareRule(Rule):
+    name = "THREADSHARE"
+    description = "thread-shared class without a lock"
+
+    def run(self, project: Project) -> Iterator[Finding]:
+        model = lock_model(project)
+        shared: dict = {}  # class qualname -> reason string (first wins)
+
+        def mark(ci: Optional[ClassInfo], reason: str) -> None:
+            if ci is not None:
+                shared.setdefault(ci.qualname, reason)
+
+        for mi in project.modules.values():
+            self._scan_module(project, mi, mark)
+
+        for qualname in sorted(shared):
+            ci = project.classes.get(qualname)
+            if ci is None:
+                continue
+            mi = project.modules.get(ci.module)
+            if mi is None:
+                continue
+            if model.class_lock_decls(ci):
+                continue
+            if self._is_waived(mi, ci):
+                continue
+            mutated = self._post_init_mutation(ci)
+            if mutated is None:
+                continue
+            yield self.finding(
+                project,
+                mi,
+                ci.node,
+                f"`{ci.node.name}` is thread-shared ({shared[qualname]}) "
+                f"but owns no lock, and `{mutated}` is mutated after "
+                "__init__ — add a threading.Lock around the mutable state, "
+                "or waive with `# phantlint: immutable` if every post-init "
+                "access is read-only",
+                context=ci.qualname,
+            )
+
+    # ------------------------------------------------------------------
+
+    def _scan_module(self, project: Project, mi: ModuleInfo, mark) -> None:
+        # module-level publications: NAME = Ctor(...) of a project class
+        for node in mi.tree.body:
+            if (
+                isinstance(node, ast.Assign)
+                and isinstance(node.value, ast.Call)
+                and any(isinstance(t, ast.Name) for t in node.targets)
+            ):
+                d = _dotted(node.value.func)
+                if d is not None:
+                    mark(
+                        project.resolve_class(mi.name, d),
+                        "published as a module-level singleton",
+                    )
+        # Thread(...) escapes, anywhere in the module
+        for owner_name, fn in self._functions(mi):
+            owner = mi.classes.get(owner_name) if owner_name else None
+            var_classes = None
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                d = _dotted(node.func)
+                if d is None or resolve_external(mi, d) != _THREAD_CTOR:
+                    continue
+                if var_classes is None:
+                    var_classes = self._ctor_vars(project, mi, fn)
+                for kw in node.keywords:
+                    if kw.arg == "target":
+                        self._mark_target(
+                            project, mi, owner, var_classes, kw.value, mark
+                        )
+                    elif kw.arg == "args" and isinstance(
+                        kw.value, (ast.Tuple, ast.List)
+                    ):
+                        for elt in kw.value.elts:
+                            if (
+                                isinstance(elt, ast.Name)
+                                and elt.id in var_classes
+                            ):
+                                mark(
+                                    var_classes[elt.id],
+                                    "passed into threading.Thread(args=…)",
+                                )
+
+    @staticmethod
+    def _functions(mi: ModuleInfo):
+        for fi in mi.functions.values():
+            yield None, fi.node
+        for cname, ci in mi.classes.items():
+            for fi in ci.methods.values():
+                yield cname, fi.node
+
+    @staticmethod
+    def _ctor_vars(project: Project, mi: ModuleInfo, fn: ast.AST):
+        out = {}
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+                d = _dotted(node.value.func)
+                if d is not None:
+                    ci = project.resolve_class(mi.name, d)
+                    if ci is not None:
+                        for tgt in node.targets:
+                            if isinstance(tgt, ast.Name):
+                                out[tgt.id] = ci
+        return out
+
+    def _mark_target(
+        self, project, mi, owner, var_classes, target: ast.AST, mark
+    ) -> None:
+        # target=self.m -> the owning instance escapes to the worker
+        if (
+            isinstance(target, ast.Attribute)
+            and isinstance(target.value, ast.Name)
+        ):
+            if target.value.id == "self" and owner is not None:
+                mark(owner, "runs a threading.Thread worker (target=self.…)")
+            elif target.value.id in var_classes:
+                mark(
+                    var_classes[target.value.id],
+                    "bound method handed to threading.Thread(target=…)",
+                )
+
+    @staticmethod
+    def _post_init_mutation(ci: ClassInfo) -> Optional[str]:
+        """First self-attribute stored outside __init__, or None."""
+        for name in sorted(ci.methods):
+            if name == "__init__":
+                continue
+            fi = ci.methods[name]
+            for node in ast.walk(fi.node):
+                targets = []
+                if isinstance(node, ast.Assign):
+                    targets = node.targets
+                elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+                    targets = [node.target]
+                for tgt in targets:
+                    if (
+                        isinstance(tgt, ast.Attribute)
+                        and isinstance(tgt.value, ast.Name)
+                        and tgt.value.id == "self"
+                    ):
+                        return tgt.attr
+        return None
+
+    @staticmethod
+    def _is_waived(mi: ModuleInfo, ci: ClassInfo) -> bool:
+        line = getattr(ci.node, "lineno", 1)
+        for i in (line - 1, line):  # the line above, then the def line
+            if 1 <= i <= len(mi.lines) and _IMMUTABLE_RE.search(
+                mi.lines[i - 1]
+            ):
+                return True
+        return False

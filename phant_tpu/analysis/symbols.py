@@ -381,10 +381,9 @@ class Project:
 
     # -- call graph ---------------------------------------------------------
 
-    def _calls_of(self, mi: ModuleInfo, fi: FunctionInfo) -> Set[str]:
-        out: Set[str] = set()
-        owner = mi.classes.get(fi.cls) if fi.cls else None
-        # local vars assigned from known constructors: var -> ClassInfo
+    def ctor_typed_locals(self, mi: ModuleInfo, fi: FunctionInfo) -> Dict[str, ClassInfo]:
+        """Local vars assigned from known constructors: var -> ClassInfo.
+        Shared by the call graph and the concurrency rules (locks.py)."""
         var_classes: Dict[str, ClassInfo] = {}
         for node in ast.walk(fi.node):
             if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
@@ -395,75 +394,96 @@ class Project:
                         for tgt in node.targets:
                             if isinstance(tgt, ast.Name):
                                 var_classes[tgt.id] = ci
-        for node in ast.walk(fi.node):
-            if not isinstance(node, ast.Call):
-                continue
-            func = node.func
-            # super().m(...)
-            if (
-                isinstance(func, ast.Attribute)
-                and isinstance(func.value, ast.Call)
-                and isinstance(func.value.func, ast.Name)
-                and func.value.func.id == "super"
-                and owner is not None
-            ):
-                for b in owner.base_names:
-                    base = self.resolve_class(mi.name, b)
-                    if base is not None:
-                        m = self.method_of(base, func.attr)
-                        if m is not None:
-                            out.add(m.qualname)
-                            break
-                continue
-            # self.m(...) / var.m(...)
-            if isinstance(func, ast.Attribute) and isinstance(func.value, ast.Name):
-                recv = func.value.id
-                if recv == "self" and owner is not None:
-                    m = self.method_of(owner, func.attr)
+        return var_classes
+
+    def callees_of(
+        self,
+        mi: ModuleInfo,
+        owner: Optional[ClassInfo],
+        node: ast.Call,
+        var_classes: Dict[str, ClassInfo],
+    ) -> Set[str]:
+        """Resolve ONE call expression to project-global callee qualnames
+        (possibly several for conservative attribute unions; empty for
+        external calls). The single resolver behind the call graph — the
+        concurrency rules (LOCKORDER/LOCKBLOCK) call it per site so their
+        interprocedural walks cannot drift from `call_graph`."""
+        out: Set[str] = set()
+        func = node.func
+        # super().m(...)
+        if (
+            isinstance(func, ast.Attribute)
+            and isinstance(func.value, ast.Call)
+            and isinstance(func.value.func, ast.Name)
+            and func.value.func.id == "super"
+            and owner is not None
+        ):
+            for b in owner.base_names:
+                base = self.resolve_class(mi.name, b)
+                if base is not None:
+                    m = self.method_of(base, func.attr)
                     if m is not None:
                         out.add(m.qualname)
-                        continue
-                if recv in var_classes:
-                    m = self.method_of(var_classes[recv], func.attr)
-                    if m is not None:
-                        out.add(m.qualname)
-                        continue
-            # self.attr.m(...) / var.attr.m(...): stored-attribute types
-            # (`self.signer = TxSigner(...)` -> `self.signer.get_sender()`)
-            if (
-                isinstance(func, ast.Attribute)
-                and isinstance(func.value, ast.Attribute)
-                and isinstance(func.value.value, ast.Name)
-            ):
-                recv = func.value.value.id
-                holder: Optional[ClassInfo] = None
-                if recv == "self" and owner is not None:
-                    holder = owner
-                elif recv in var_classes:
-                    holder = var_classes[recv]
-                if holder is not None:
-                    resolved_any = False
-                    for target in self.attr_classes_of(holder, func.value.attr):
-                        m = self.method_of(target, func.attr)
-                        if m is not None:
-                            out.add(m.qualname)
-                            resolved_any = True
-                    if resolved_any:
-                        continue
-            d = _dotted(func)
-            if d is None:
-                continue
-            q = self.resolve_name(mi.name, d)
-            if q is None:
-                continue
-            if q in self.functions:
-                out.add(q)
-            elif q in self.classes:
-                ci = self.classes[q]
-                out.add(ci.qualname)  # constructor marker
-                m = self.method_of(ci, "__init__")
+                        break
+            return out
+        # self.m(...) / var.m(...)
+        if isinstance(func, ast.Attribute) and isinstance(func.value, ast.Name):
+            recv = func.value.id
+            if recv == "self" and owner is not None:
+                m = self.method_of(owner, func.attr)
                 if m is not None:
                     out.add(m.qualname)
+                    return out
+            if recv in var_classes:
+                m = self.method_of(var_classes[recv], func.attr)
+                if m is not None:
+                    out.add(m.qualname)
+                    return out
+        # self.attr.m(...) / var.attr.m(...): stored-attribute types
+        # (`self.signer = TxSigner(...)` -> `self.signer.get_sender()`)
+        if (
+            isinstance(func, ast.Attribute)
+            and isinstance(func.value, ast.Attribute)
+            and isinstance(func.value.value, ast.Name)
+        ):
+            recv = func.value.value.id
+            holder: Optional[ClassInfo] = None
+            if recv == "self" and owner is not None:
+                holder = owner
+            elif recv in var_classes:
+                holder = var_classes[recv]
+            if holder is not None:
+                resolved_any = False
+                for target in self.attr_classes_of(holder, func.value.attr):
+                    m = self.method_of(target, func.attr)
+                    if m is not None:
+                        out.add(m.qualname)
+                        resolved_any = True
+                if resolved_any:
+                    return out
+        d = _dotted(func)
+        if d is None:
+            return out
+        q = self.resolve_name(mi.name, d)
+        if q is None:
+            return out
+        if q in self.functions:
+            out.add(q)
+        elif q in self.classes:
+            ci = self.classes[q]
+            out.add(ci.qualname)  # constructor marker
+            m = self.method_of(ci, "__init__")
+            if m is not None:
+                out.add(m.qualname)
+        return out
+
+    def _calls_of(self, mi: ModuleInfo, fi: FunctionInfo) -> Set[str]:
+        out: Set[str] = set()
+        owner = mi.classes.get(fi.cls) if fi.cls else None
+        var_classes = self.ctor_typed_locals(mi, fi)
+        for node in ast.walk(fi.node):
+            if isinstance(node, ast.Call):
+                out |= self.callees_of(mi, owner, node, var_classes)
         return out
 
     def reachable(self, entries: Sequence[str]) -> Set[str]:

@@ -137,8 +137,17 @@ def _device_link_profile_locked() -> tuple:
         size_small = 1 << 20
         size_big = 12 << 20
         warm_buf = rng.integers(0, 256, size_small, dtype=np.uint8)
-        buf_small = rng.integers(0, 256, size_small, dtype=np.uint8)
-        buf_big = rng.integers(0, 256, size_big, dtype=np.uint8)
+        # DISTINCT buffer per sample, not one buffer timed 3x: jax dedupes
+        # a repeated transfer of the same host buffer, so samples 2 and 3
+        # of a reused array measure ~0s and the min() elects a petabytes/s
+        # "link" (exactly the flattery the comment above warns about). All
+        # RNG generation stays OUTSIDE the timed window.
+        bufs_small = [
+            rng.integers(0, 256, size_small, dtype=np.uint8) for _ in range(3)
+        ]
+        bufs_big = [
+            rng.integers(0, 256, size_big, dtype=np.uint8) for _ in range(3)
+        ]
         # sum the WHOLE buffer: consuming only a slice lets the transport
         # defer most of the transfer (observed: a sliced readback clocked
         # the 1MB upload at the 50 GB/s sanity clamp). The on-device sum
@@ -147,12 +156,12 @@ def _device_link_profile_locked() -> tuple:
         # min-of-3 per size (same rationale as the latency probe: one
         # scheduler hiccup must not skew routing for the process lifetime)
         t_small = min(
-            _timed(lambda: int(jnp.sum(jnp.asarray(buf_small))), time)  # phantlint: disable=HOSTSYNC — timed probe
-            for _ in range(3)
+            _timed(lambda b=b: int(jnp.sum(jnp.asarray(b))), time)  # phantlint: disable=HOSTSYNC — timed probe
+            for b in bufs_small
         )
         t_big = min(
-            _timed(lambda: int(jnp.sum(jnp.asarray(buf_big))), time)  # phantlint: disable=HOSTSYNC — timed probe
-            for _ in range(3)
+            _timed(lambda b=b: int(jnp.sum(jnp.asarray(b))), time)  # phantlint: disable=HOSTSYNC — timed probe
+            for b in bufs_big
         )
         # slope over the size delta cancels RTT and fixed dispatch costs.
         # A non-positive slope means the probe is unusable (a hiccup ate

@@ -84,6 +84,18 @@ class Blockchain:
         # chain config (fork-activation schedule); the stateless handler
         # uses it to pick the fork for witness-backed execution
         self.config = config
+        # a config naming a known public network arms the KZG dev-setup
+        # guard: 0x0A must refuse the forgeable dev tau there (crypto/kzg
+        # set_public_network; config-less fixture chains stay unguarded)
+        if config is not None:
+            from phant_tpu.config import PUBLIC_CHAIN_IDS
+
+            if getattr(config, "chainId", None) in PUBLIC_CHAIN_IDS:
+                from phant_tpu.crypto import kzg
+
+                kzg.set_public_network(
+                    getattr(config, "ChainName", None) or str(config.chainId)
+                )
 
     # ------------------------------------------------------------------
 

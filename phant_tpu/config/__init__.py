@@ -31,6 +31,15 @@ class ChainId(enum.IntEnum):
     Sepolia = 11155111
 
 
+#: Chain ids whose blobs arrive from a network the operator does not
+#: control — consensus objects (the KZG trusted setup above all) must be
+#: the real ceremony data there, never the forgeable dev constants that
+#: serve config-less fixture chains (phant_tpu/crypto/kzg.py).
+PUBLIC_CHAIN_IDS = frozenset(
+    {ChainId.Mainnet, ChainId.Goerli, ChainId.Holesky, ChainId.Sepolia}
+)
+
+
 class UnsupportedNetwork(Exception):
     pass
 

@@ -46,6 +46,33 @@ _DEV_TAU = (
 _SETUP: Optional[Tuple[bls.G2Point, str]] = None
 _setup_lock = threading.Lock()
 
+# Name of the public network the process is serving, or None for fixture /
+# self-generated chains. Set by Blockchain.__init__ when its chain config
+# names a PUBLIC_CHAIN_IDS member; 0x0A refuses the insecure-dev setup
+# while this is set (precompiles_bls.point_evaluation) — a forgeable tau
+# on a chain whose blobs arrive from strangers is consensus theater.
+_PUBLIC_NETWORK: Optional[str] = None
+
+
+def set_public_network(name: Optional[str]) -> None:
+    """Declare (or clear, with None) the public network being validated."""
+    global _PUBLIC_NETWORK
+    _PUBLIC_NETWORK = name
+
+
+def public_network() -> Optional[str]:
+    return _PUBLIC_NETWORK
+
+
+def configured_source() -> str:
+    """What `setup_source()` WOULD report, without paying for the dev
+    setup's g2_mul: the cached answer when the setup is already loaded,
+    otherwise a peek at the operator env knob. Lets the 0x0A public-network
+    gate refuse the dev setup before ever computing it."""
+    if _SETUP is not None:
+        return _SETUP[1]
+    return "operator" if os.environ.get("PHANT_KZG_SETUP_G2", "") else "insecure-dev"
+
 
 def dev_tau() -> int:
     """The dev setup's tau (public by construction — tests use it to build

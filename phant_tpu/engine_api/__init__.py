@@ -599,6 +599,35 @@ SUPPORTED_METHODS = (
 )
 
 
+#: JSON-RPC error for a versioned newPayload whose timestamp falls outside
+#: the method's fork window (Engine API spec "Unsupported fork" rule)
+UNSUPPORTED_FORK_CODE = -38005
+
+
+def _unsupported_fork(blockchain, timestamp: int, version: int) -> bool:
+    """Engine API fork-timestamp rule: newPayloadV3 serves exactly the
+    Cancun window, V4 exactly Prague — a payload timestamp outside the
+    method's window must return -38005 rather than execute under the
+    wrong rules. Only a chain config can place the fork boundaries;
+    config-less fixture chains skip the check (their tests drive any
+    version against any payload)."""
+    config = getattr(blockchain, "config", None)
+    if config is None:
+        return False
+    cancun = getattr(config, "cancunTime", None)
+    prague = getattr(config, "pragueTime", None)
+    osaka = getattr(config, "osakaTime", None)
+    if version == 3:
+        if cancun is None or timestamp < cancun:
+            return True
+        return prague is not None and timestamp >= prague
+    if version == 4:
+        if prague is None or timestamp < prague:
+            return True
+        return osaka is not None and timestamp >= osaka
+    return False
+
+
 def handle_request(blockchain, request: dict) -> Tuple[int, dict]:
     """Dispatch one JSON-RPC request; returns (http_status, response_body)
     (reference: engineAPIHandler, main.zig:56-74)."""
@@ -629,6 +658,14 @@ def handle_request(blockchain, request: dict) -> Tuple[int, dict]:
                     hex_to_hash(h) for h in request["params"][1]
                 ]
                 beacon_root = hex_to_hash(request["params"][2])
+            if _unsupported_fork(blockchain, payload.timestamp, version=3):
+                return 200, {
+                    **base,
+                    "error": {
+                        "code": UNSUPPORTED_FORK_CODE,
+                        "message": "Unsupported fork",
+                    },
+                }
             with metrics.phase("engine_api.new_payload"):
                 status = new_payload_v3_handler(
                     blockchain, payload, expected_hashes, beacon_root
@@ -642,6 +679,14 @@ def handle_request(blockchain, request: dict) -> Tuple[int, dict]:
                 ]
                 beacon_root = hex_to_hash(request["params"][2])
                 execution_requests = request["params"][3]
+            if _unsupported_fork(blockchain, payload.timestamp, version=4):
+                return 200, {
+                    **base,
+                    "error": {
+                        "code": UNSUPPORTED_FORK_CODE,
+                        "message": "Unsupported fork",
+                    },
+                }
             with metrics.phase("engine_api.new_payload"):
                 status = new_payload_v4_handler(
                     blockchain,

@@ -195,7 +195,7 @@ def _healthz_payload() -> tuple:
     # every debug-ring capacity in one place (the --flight-ring /
     # --timeline-* config surfaces echo back what actually took effect)
     payload["debug_rings"] = {
-        "flight": flight.capacity,
+        "flight": flight.ring_capacity(),
         "slow": critpath.slow.capacity,
         "timeline": timeline.capacity(),
     }
@@ -262,10 +262,7 @@ class _ObservableHandler(BaseHTTPRequestHandler):
             # are caller-provided and may not all be JSON-native)
             self._reply_raw(
                 200,
-                json.dumps(
-                    {"capacity": flight.capacity, "records": flight.records()},
-                    default=str,
-                ).encode(),
+                json.dumps(flight.snapshot(), default=str).encode(),
                 "application/json",
             )
         elif path == "/debug/timeline":

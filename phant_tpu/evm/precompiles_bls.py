@@ -182,6 +182,20 @@ _POINT_EVAL_OUTPUT = (4096).to_bytes(32, "big") + bls.R.to_bytes(32, "big")
 def point_evaluation(data: bytes, gas: int) -> ExecResult:
     from phant_tpu.crypto import kzg
 
+    # Public-network guard (ADVICE high): on a chain whose config names a
+    # known public network (Blockchain.__init__ -> kzg.set_public_network),
+    # the dev setup's tau is a PUBLIC constant — anyone can forge a proof
+    # against it, so "verification" would be consensus theater. Raise (not
+    # a call failure): success and failure are both consensus-visible, and
+    # the tree's policy for unverifiable consensus data is a loud abort.
+    # Config-less fixture chains keep the dev tau.
+    net = kzg.public_network()
+    if net is not None and kzg.configured_source() == "insecure-dev":
+        raise ConsensusDataUnavailable(
+            f"KZG trusted setup: refusing the insecure dev setup on public "
+            f"network {net!r}; supply the ceremony [tau]_2 via "
+            f"PHANT_KZG_SETUP_G2"
+        )
     if gas < POINT_EVALUATION_GAS:
         return ExecResult(False, 0, error="out of gas")
     gas -= POINT_EVALUATION_GAS

@@ -96,6 +96,21 @@ class FlightRecorder:
         with self._lock:
             return list(self._ring)
 
+    def ring_capacity(self) -> int:
+        """The capacity, read under the ring lock: handler threads report
+        it (/healthz debug_rings) while resize()/refresh_from_env()
+        rewrite it — the unlocked attribute read was phantsan's first
+        real-tree catch (the same field the pre-PR-16 dump() bug tore)."""
+        with self._lock:
+            return self.capacity
+
+    def snapshot(self) -> dict:
+        """Capacity AND records from one lock region — a /debug/flight
+        reply must not pair a post-resize capacity with a pre-resize
+        ring."""
+        with self._lock:
+            return {"capacity": self.capacity, "records": list(self._ring)}
+
     def resize(self, capacity: int) -> None:
         """Rebuild the ring at a new capacity, keeping the NEWEST records
         (a shrink drops from the oldest end — ring semantics)."""

@@ -30,7 +30,10 @@ from phant_tpu.utils.trace import metrics
 _DEFAULT_INTERVAL_S = 0.25
 
 
-class Watchdog:
+# the one mutable field, _last_flagged, is read and written ONLY by the
+# watchdog's own worker thread (_run); start/stop touch the Event, which
+# carries its own lock
+class Watchdog:  # phantlint: disable=THREADSHARE — worker-thread-private state
     """Polls `source()` — a callable returning the in-flight descriptor
     `{"batch_id", "lane", "started", "deadline", "trace_ids"}` or None —
     and records each batch's first deadline overrun."""

@@ -212,8 +212,11 @@ def pallas_available() -> bool:
                 else:
                     w = jnp.zeros((1, 1, 34), jnp.uint32)
                     n = jnp.ones((1,), jnp.int32)
-                    # the probe VERIFIES the kernel runs — the block is the point
-                    keccak256_chunked_pallas(w, n, max_chunks=1).block_until_ready()  # phantlint: disable=HOSTSYNC — one-shot Mosaic probe
+                    # the probe VERIFIES the kernel runs — the block is the
+                    # point, and holding _probe_lock across it is too: a
+                    # second thread must WAIT for the one probe, not run its
+                    # own (the memo exists to pay this exactly once)
+                    keccak256_chunked_pallas(w, n, max_chunks=1).block_until_ready()  # phantlint: disable=HOSTSYNC,LOCKBLOCK — one-shot Mosaic probe
                     _PALLAS_OK = True
             except Exception:
                 _PALLAS_OK = False

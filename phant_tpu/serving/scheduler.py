@@ -570,6 +570,12 @@ class VerificationScheduler:
         # sig-lane engine, resolved lazily on the first sig batch (the
         # shared ops/sig_engine.py engine unless the config injects one)
         self._sig_engine = None
+        # guards the three lazy engine memos above — dedicated lock, NOT
+        # self._lock: the first resolve builds an engine (seconds of
+        # compile), and admission must not block behind it. The executor
+        # resolves, the resolve worker reads the memo on its fallback
+        # path: without the lock that pair is a lockset race (phantsan)
+        self._engine_lock = threading.Lock()
         # mesh dispatch: per-device executors behind the assembler. The
         # pool is built here (its engines are jax-free until the device
         # route engages) and the scheduler's own resolve worker is NOT —
@@ -917,14 +923,19 @@ class VerificationScheduler:
             )
 
     def _resolve_root_engine(self):
-        if self._root_engine is None:
-            if self.config.root_engine_factory is not None:
-                self._root_engine = self.config.root_engine_factory()
-            else:
-                from phant_tpu.ops.root_engine import shared_root_engine
+        # config is read OUTSIDE the lock (immutable after __init__; a
+        # config touch under _engine_lock would make LOCK demand the lock
+        # at every other config read in the class)
+        factory = self.config.root_engine_factory
+        with self._engine_lock:
+            if self._root_engine is None:
+                if factory is not None:
+                    self._root_engine = factory()
+                else:
+                    from phant_tpu.ops.root_engine import shared_root_engine
 
-                self._root_engine = shared_root_engine()
-        return self._root_engine
+                    self._root_engine = shared_root_engine()
+            return self._root_engine
 
     # -- sig lane (coalesced sender recovery, PR 14) --------------------------
 
@@ -1028,14 +1039,16 @@ class VerificationScheduler:
         return self.accepts_witness()
 
     def _resolve_sig_engine(self):
-        if self._sig_engine is None:
-            if self.config.sig_engine_factory is not None:
-                self._sig_engine = self.config.sig_engine_factory()
-            else:
-                from phant_tpu.ops.sig_engine import shared_sig_engine
+        factory = self.config.sig_engine_factory  # outside the lock, as above
+        with self._engine_lock:
+            if self._sig_engine is None:
+                if factory is not None:
+                    self._sig_engine = factory()
+                else:
+                    from phant_tpu.ops.sig_engine import shared_sig_engine
 
-                self._sig_engine = shared_sig_engine()
-        return self._sig_engine
+                    self._sig_engine = shared_sig_engine()
+            return self._sig_engine
 
     @staticmethod
     def _payload_of(jobs: List[_Job], kind: str) -> list:
@@ -1435,6 +1448,15 @@ class VerificationScheduler:
 
     # -- executor ------------------------------------------------------------
 
+    def _set_exec_stage(self, stage: str) -> None:
+        """Crash-record breadcrumb: the executor names the stage it is in
+        at each boundary, and _run's except handler reads it for _die.
+        Under _lock — writer and reader are different threads when a mesh
+        lane or the chaos drill kills the executor mid-batch, and the
+        unlocked attribute was a phantsan lockset race."""
+        with self._lock:
+            self._exec_stage = stage
+
     def _run(self) -> None:
         batch: List[_Job] = []
         try:
@@ -1463,7 +1485,9 @@ class VerificationScheduler:
                 self._execute(batch)
                 batch = []
         except BaseException as e:  # systemic: engine/internal failure
-            self._die(e, batch or [], stage=self._exec_stage)
+            with self._lock:
+                stage = self._exec_stage
+            self._die(e, batch or [], stage=stage)
 
     _exec_done = False  # executor returned cleanly (resolve worker exits)
 
@@ -1609,7 +1633,7 @@ class VerificationScheduler:
         twin of _execute_witness_pipelined, consuming the worker's plan
         so pack's under-lock work shrinks to the re-check + commit."""
         batch_id = item["batch_id"]
-        self._exec_stage = "pack"
+        self._set_exec_stage("pack")
         with self._lock:
             for d in self._inflight_list:
                 if d["batch_id"] == batch_id:
@@ -2048,7 +2072,7 @@ class VerificationScheduler:
         if lane == _SERIAL:
             # serial exclusivity covers the PIPELINE too: a state mutation
             # must not run while dispatched witness handles are in flight
-            self._exec_stage = "serial"
+            self._set_exec_stage("serial")
             self._drain_pipeline()
             with self._lock:
                 dead = self._dead
@@ -2070,9 +2094,9 @@ class VerificationScheduler:
             engine = None
             pipelined = False
             stage = "dispatch"
-            self._exec_stage = stage
+            self._set_exec_stage(stage)
         else:
-            self._exec_stage = "pack"  # provisional: engine resolution
+            self._set_exec_stage("pack")  # provisional: engine resolution
             if lane == _ROOT:
                 engine = self._resolve_root_engine()
             elif lane == _SIG:
@@ -2086,7 +2110,7 @@ class VerificationScheduler:
             # _exec_stage must AGREE with the batch_start record — a
             # depth-1 crash (chaos drill included) has no pack stage
             stage = "pack" if pipelined else "dispatch"
-            self._exec_stage = stage
+            self._set_exec_stage(stage)
         with self._lock:
             self._batch_seq += 1
             batch_id = self._batch_seq
@@ -2212,7 +2236,7 @@ class VerificationScheduler:
             raise RuntimeError(
                 "chaos drill: PHANT_SCHED_CHAOS_CRASH=1 induced executor crash"
             )
-        self._exec_stage = "dispatch"
+        self._set_exec_stage("dispatch")
         s0 = self._engine_cache_stats(engine)
         # the engine/device dispatch this scheduler exists for: one
         # verify_batch over the whole coalesced bucket. An exception here
@@ -2266,7 +2290,7 @@ class VerificationScheduler:
         jobs = self._shed_or_keep(batch, picked)
         if not jobs:
             return
-        self._exec_stage = "dispatch"
+        self._set_exec_stage("dispatch")
         self._busy_acct.begin()
         try:
             handle = engine.begin_batch([j.plan for j in jobs])
@@ -2299,15 +2323,8 @@ class VerificationScheduler:
         n = len(jobs)
         done = time.monotonic()
         served: dict = {}
-        for j, result in zip(jobs, results):
+        for j in jobs:
             served[j.tenant] = served.get(j.tenant, 0) + 1
-            # meta BEFORE set_result (the *_traced ordering contract)
-            j.meta = {
-                **record,
-                "tenant": j.tenant,
-                "queue_wait_ms": round((picked - j.admitted) * 1e3, 3),
-            }
-            _safe_resolve(j.future, result)
         flight.record(
             "sched.batch_done",
             duration_ms=round((done - picked) * 1e3, 3),
@@ -2338,6 +2355,19 @@ class VerificationScheduler:
             if n > 1:
                 st[lane + "_coalesced"] += n
                 st["coalesced"] += n
+        # futures resolve LAST: the future is the publication point, so a
+        # waiter that observed its result must also observe the batch in
+        # stats_snapshot()/metrics/flight (phantsan caught the inversion —
+        # resolve-then-count let a freshly-unblocked caller read a
+        # snapshot the batch had not reached yet)
+        for j, result in zip(jobs, results):
+            # meta BEFORE set_result (the *_traced ordering contract)
+            j.meta = {
+                **record,
+                "tenant": j.tenant,
+                "queue_wait_ms": round((picked - j.admitted) * 1e3, 3),
+            }
+            _safe_resolve(j.future, result)
             if n > st["max_batch_seen"]:
                 st["max_batch_seen"] = n
             for tenant, cnt in served.items():
@@ -2368,7 +2398,7 @@ class VerificationScheduler:
         jobs = self._shed_or_keep(batch, picked)
         if not jobs:
             return
-        self._exec_stage = "dispatch"
+        self._set_exec_stage("dispatch")
         self._busy_acct.begin()
         try:
             handle = engine.begin_batch([j.rows for j in jobs])
@@ -2537,16 +2567,8 @@ class VerificationScheduler:
         padded = _pow2ceil(total)
         done = time.monotonic()
         served: dict = {}
-        for j, ok in zip(jobs, verdicts):
+        for j in jobs:
             served[j.tenant] = served.get(j.tenant, 0) + 1
-            # meta BEFORE set_result: a waiter that observed the verdict
-            # must also observe its batch record (verify_traced)
-            j.meta = {
-                **record,
-                "tenant": j.tenant,
-                "queue_wait_ms": round((picked - j.admitted) * 1e3, 3),
-            }
-            _safe_resolve(j.future, bool(ok))
         flight.record(
             "sched.batch_done",
             lane=_WITNESS,
@@ -2585,6 +2607,17 @@ class VerificationScheduler:
                 st["max_batch_seen"] = n
             for tenant, cnt in served.items():
                 self._tenant_locked(tenant)["served"] += cnt
+        # futures resolve LAST (see _finish_plan_jobs): a waiter that saw
+        # its verdict must also see the batch in stats and metrics
+        for j, ok in zip(jobs, verdicts):
+            # meta BEFORE set_result: a waiter that observed the verdict
+            # must also observe its batch record (verify_traced)
+            j.meta = {
+                **record,
+                "tenant": j.tenant,
+                "queue_wait_ms": round((picked - j.admitted) * 1e3, 3),
+            }
+            _safe_resolve(j.future, bool(ok))
 
     # -- resolve worker (pipeline_depth > 1) ---------------------------------
 
@@ -2624,7 +2657,7 @@ class VerificationScheduler:
             # elsewhere in the loop still must not leak it
             if item is not None:
                 _abandon_handle(
-                    item.get("engine") or self._engine, item["handle"]
+                    item.get("engine") or self._resolve_engine(), item["handle"]
                 )
             self._die(e, item["jobs"] if item else [], stage="resolve")
 
@@ -2639,7 +2672,7 @@ class VerificationScheduler:
     def _resolve_one_inner(self, item: dict) -> None:
         jobs = item["jobs"]
         handle = item["handle"]
-        engine = item.get("engine") or self._engine
+        engine = item.get("engine") or self._resolve_engine()
         t0 = time.monotonic()
         if item.get("kind") == _ROOT:
             results = engine.resolve_batch(handle)
@@ -2666,11 +2699,12 @@ class VerificationScheduler:
         finish(jobs, results, record, item["picked"])
 
     def _resolve_engine(self):
-        if self._engine is None:
-            from phant_tpu.stateless import shared_witness_engine
+        with self._engine_lock:
+            if self._engine is None:
+                from phant_tpu.stateless import shared_witness_engine
 
-            self._engine = shared_witness_engine()
-        return self._engine
+                self._engine = shared_witness_engine()
+            return self._engine
 
     def _die(
         self,
@@ -2720,7 +2754,7 @@ class VerificationScheduler:
             # a shared engine keeps evicting after this scheduler's death
             # (each pipe item carries ITS engine — witness or root), and
             # close each one's device-busy interval (begun at handoff)
-            _abandon_handle(item.get("engine") or self._engine, item["handle"])
+            _abandon_handle(item.get("engine") or self._resolve_engine(), item["handle"])
             self._busy_acct.end()
         for item in dropped_plans:
             plan = item.get("plan")

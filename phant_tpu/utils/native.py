@@ -415,7 +415,10 @@ def load_engine_ext():
                     *(str(s) for s in srcs),
                     "-o", str(_EXT_PATH),
                 ]
-                subprocess.run(cmd, check=True, capture_output=True)
+                # the one-time g++ compile runs UNDER _ext_lock on purpose:
+                # concurrent first callers must wait for one build, not
+                # race two compilers over the same .so path
+                subprocess.run(cmd, check=True, capture_output=True)  # phantlint: disable=LOCKBLOCK — serialized one-time build
             import importlib.util
             from importlib.machinery import ExtensionFileLoader
 
@@ -441,7 +444,9 @@ def load_native() -> Optional[NativeLib]:
         if _loaded is not None:
             return _loaded
         try:
-            path = build_native()
+            # same contract as load_engine_ext: the (possibly seconds-long)
+            # build is serialized under _lock so exactly one compile runs
+            path = build_native()  # phantlint: disable=LOCKBLOCK — serialized one-time build
             _loaded = NativeLib(ctypes.CDLL(str(path)))
         except Exception:
             _load_failed = True

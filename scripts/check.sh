@@ -64,6 +64,17 @@ rc=$?
 echo "[check] group phantlint: rc=$rc in $(( $(date +%s) - t0 ))s"
 if [ "$rc" -ne 0 ]; then fail=1; fi
 
+# Second lint pass: scripts/ under the concurrency rules only (soak,
+# loadgen, and bench spawn threads too; the JAX-hygiene rules don't
+# apply to host-side driver scripts). Same EMPTY baseline.
+t0=$(date +%s)
+JAX_PLATFORMS=cpu python scripts/phantlint.py scripts/ \
+  --rules LOCK,LOCKORDER,LOCKBLOCK,THREADSHARE \
+  --baseline scripts/phantlint_baseline.json
+rc=$?
+echo "[check] group phantlint-scripts: rc=$rc in $(( $(date +%s) - t0 ))s"
+if [ "$rc" -ne 0 ]; then fail=1; fi
+
 run_group() {
   local name="$1"; shift
   local t0 t1 rc
@@ -92,6 +103,16 @@ run_group core tests/ "${CORE_IGNORES[@]}" "$@"
 # core group ignores these files, so each runs exactly twice.
 PHANT_SCHED_PIPELINE_DEPTH=2 run_group serving_pipelined tests/test_serving.py tests/test_obs.py tests/test_serving_mesh.py tests/test_witness_stream.py tests/test_post_root.py tests/test_commitment.py tests/test_sender_lane.py tests/test_critpath.py tests/test_timeline.py "$@"
 PHANT_SCHED_PIPELINE_DEPTH=1 run_group serving_depth1 tests/test_serving.py tests/test_obs.py tests/test_serving_mesh.py tests/test_witness_stream.py tests/test_post_root.py tests/test_commitment.py tests/test_sender_lane.py tests/test_critpath.py tests/test_timeline.py "$@"
+
+# The same serving path once more under phantsan (PR 17): PHANT_SANITIZE=1
+# turns threading.Lock/RLock into instrumented proxies and puts per-field
+# lockset tracking (Eraser) on the scheduler/obs shared classes; any
+# two-stack race report fails the group via conftest's
+# pytest_sessionfinish. Depth 2 keeps the pipelined pack/dispatch/resolve
+# overlap — the schedule on which phantsan caught the resolve-before-count
+# and lazy-init races this gate now pins. All three engine lanes run:
+# witness (test_serving), root (test_post_root), sig (test_sender_lane).
+PHANT_SANITIZE=1 PHANT_SCHED_PIPELINE_DEPTH=2 run_group serving_sanitized tests/test_serving.py tests/test_post_root.py tests/test_sender_lane.py "$@"
 if [ "${PHANT_CHECK_DEVICE:-1}" != "0" ]; then
   for f in "${DEVICE_GROUPS[@]}"; do
     run_group "$(basename "$f" .py)" "$f" "$@"

@@ -36,6 +36,12 @@ executeStatelessPayloadV1 — and asserts the obs postmortem contract:
     records include the `sched.executor_crash` event AND the crashing
     batch's trace ids (joinable to the HTTP X-Phant-Trace header);
   * `/healthz` flips to 503 and the flip writes its own dump.
+
+The final phase (`_sanitizer_phase`, PR 17) re-runs a depth-2 pipelined
+scheduler under threaded submit pressure with the phantsan lockset race
+sanitizer (phant_tpu/analysis/sanitizer.py) enabled: instrumented lock
+proxies + per-field lockset tracking, Eraser-style. Any two-stack race
+report fails the soak.
 """
 
 from __future__ import annotations
@@ -189,7 +195,10 @@ def main() -> int:
     rc = _timeline_phase()
     if rc:
         return rc
-    return _qos_phase()
+    rc = _qos_phase()
+    if rc:
+        return rc
+    return _sanitizer_phase()
 
 
 def _crash_phase() -> int:
@@ -1154,6 +1163,76 @@ def _qos_phase() -> int:
         f"head p99 {overload.get('head_p99_ms')}ms, "
         f"{checks['adaptive_wait_adjustments']} adaptive-wait adjustments, "
         f"no starvation, loris closed"
+    )
+    return 0
+
+
+def _sanitizer_phase() -> int:
+    """Lockset-sanitized serving soak (PR 17): phantsan — the Eraser-style
+    race detector in phant_tpu/analysis/sanitizer.py — watches a depth-2
+    pipelined scheduler under multi-threaded submit pressure with
+    instrumented lock proxies and per-field lockset tracking. ANY race
+    report (two-stack, field-level) fails the phase: the sanitizer's
+    perturbation of lock timing is exactly the stress the pytest groups
+    can't apply, and it has already caught real resolve-before-count and
+    lazy-init races in this scheduler.
+
+    Only VerificationScheduler is registered here (NOT the obs
+    singletons): lock proxies wrap Lock()/RLock() calls made AFTER
+    enable(), and flight/metrics built their real locks at module import
+    — tracking them now would report their correctly-locked accesses as
+    unprotected. The pytest sanitizer session (PHANT_SANITIZE=1, enabled
+    at conftest import before anything else) covers those classes."""
+    from phant_tpu.analysis import sanitizer
+    from phant_tpu.ops.witness_engine import WitnessEngine
+
+    from test_serving import _witness_set
+
+    failures: list = []
+    # enable BEFORE constructing the scheduler: only locks created after
+    # enable() are proxies, and field tracking needs the class registered
+    # before the instance starts writing
+    sanitizer.enable()
+    from phant_tpu.serving.scheduler import VerificationScheduler
+
+    sanitizer.register_shared_class(VerificationScheduler)
+    try:
+        from phant_tpu.serving import SchedulerConfig
+
+        wits = _witness_set(96, trie_size=512, picks=8, seed=23)
+        with VerificationScheduler(
+            engine=WitnessEngine(),
+            config=SchedulerConfig(
+                max_batch=8, max_wait_ms=5.0, queue_depth=4096,
+                pipeline_depth=2,
+            ),
+        ) as s:
+            with ThreadPoolExecutor(max_workers=6) as pool:
+                outs = list(
+                    pool.map(
+                        lambda w: s.submit_witness(*w).result(timeout=120),
+                        wits,
+                    )
+                )
+            st = s.stats_snapshot()
+        if not all(outs):
+            failures.append(f"sanitized verdicts not all VALID: {sum(outs)}/{len(outs)}")
+        if st["pipelined_batches"] < 1:
+            failures.append(f"sanitized soak never pipelined: {st}")
+    finally:
+        reports = sanitizer.drain_reports()
+        sanitizer.unregister(VerificationScheduler)
+        sanitizer.disable()
+    for r in reports:
+        failures.append("phantsan race report:\n" + r.format())
+    if failures:
+        for f in failures:
+            print(f"[soak] FAIL (sanitizer phase): {f}", file=sys.stderr)
+        return 1
+    print(
+        f"[soak] sanitizer phase green: {len(wits)} sanitized verifications "
+        f"over 6 threads at depth 2, {st['pipelined_batches']} pipelined "
+        "batches, zero race reports"
     )
     return 0
 

@@ -111,3 +111,36 @@ def evm_backend_cpu(request):
     family keeps a couple of representative 3-backend tests on
     `evm_backend` and runs the rest here (VERDICT r4 #10: gate time)."""
     yield from _backend_combo(request.param)
+
+
+# ---------------------------------------------------------------------------
+# phantsan: PHANT_SANITIZE=1 runs the whole session under the lockset race
+# sanitizer (phant_tpu/analysis/sanitizer.py). Enabled at conftest import —
+# before any test module imports the serving stack — so every
+# threading.Lock/RLock the scheduler, engines, and obs rings construct is a
+# tracking proxy. sessionfinish fails the run on undrained reports; the
+# deliberately-racy fixtures in test_sanitizer.py drain their own.
+# ---------------------------------------------------------------------------
+
+_PHANT_SANITIZE = os.environ.get("PHANT_SANITIZE") == "1"
+
+if _PHANT_SANITIZE:
+    from phant_tpu.analysis import sanitizer as _sanitizer
+
+    _sanitizer.enable()
+    _sanitizer.register_default_shared_classes()
+
+
+def pytest_sessionfinish(session, exitstatus):
+    if not _PHANT_SANITIZE:
+        return
+    from phant_tpu.analysis import sanitizer as _sanitizer
+
+    reports = _sanitizer.drain_reports()
+    if reports:
+        sys.stderr.write("\n\n".join(r.format() for r in reports) + "\n")
+        sys.stderr.write(
+            f"\nphantsan: {len(reports)} data race(s) detected — failing "
+            "the sanitized session\n"
+        )
+        session.exitstatus = 1

@@ -153,6 +153,61 @@ def test_kzg_setup_source_is_dev_without_operator_bytes(monkeypatch):
     kzg.reset_setup_cache()
 
 
+def test_point_evaluation_refuses_dev_setup_on_public_network(monkeypatch):
+    """ADVICE regression: a chain config naming a public network must make
+    0x0A abort loudly on the insecure dev setup, never 'verify' against a
+    forgeable tau — and the refusal must not compute the dev setup."""
+    monkeypatch.delenv("PHANT_KZG_SETUP_G2", raising=False)
+    kzg.reset_setup_cache()
+    data, _ = _kzg_fixture(z=11)
+    kzg.set_public_network("mainnet")
+    try:
+        with pytest.raises(pb.ConsensusDataUnavailable, match="mainnet"):
+            pb.point_evaluation(data, 60_000)
+        # the guard rejected via configured_source() WITHOUT paying for the
+        # dev g2_mul — the setup memo must still be cold
+        assert kzg.configured_source() == "insecure-dev"
+        # operator-supplied ceremony bytes lift the refusal
+        g2tau = bls.g2_compress(bls.g2_mul(bls.G2_GEN, kzg.dev_tau()))
+        monkeypatch.setenv("PHANT_KZG_SETUP_G2", g2tau.hex())
+        kzg.reset_setup_cache()
+        assert pb.point_evaluation(data, 60_000).success
+    finally:
+        kzg.set_public_network(None)
+        kzg.reset_setup_cache()
+
+
+def test_point_evaluation_keeps_dev_setup_for_configless_chains(monkeypatch):
+    """Config-less fixture chains (no public network declared) keep the
+    dev tau — the entire test corpus depends on it."""
+    monkeypatch.delenv("PHANT_KZG_SETUP_G2", raising=False)
+    kzg.reset_setup_cache()
+    kzg.set_public_network(None)
+    data, _ = _kzg_fixture(z=12)
+    assert pb.point_evaluation(data, 60_000).success
+    assert kzg.setup_source() == "insecure-dev"
+    kzg.reset_setup_cache()
+
+
+def test_blockchain_with_public_chainspec_arms_kzg_guard(monkeypatch):
+    """Constructing a Blockchain with a mainnet chainspec declares the
+    public network to kzg; a fixture config (Testing chain id) does not."""
+    from phant_tpu.blockchain.chain import Blockchain
+    from phant_tpu.config import ChainConfig
+    from phant_tpu.state.statedb import StateDB
+    from phant_tpu.types.block import BlockHeader
+
+    parent = BlockHeader()
+    try:
+        Blockchain(1337, StateDB(), parent, config=ChainConfig(chainId=1337))
+        assert kzg.public_network() is None
+        cfg = ChainConfig.from_chain_id(1)
+        Blockchain(1, StateDB(), parent, config=cfg)
+        assert kzg.public_network() == cfg.ChainName
+    finally:
+        kzg.set_public_network(None)
+
+
 # ---------------------------------------------------------------------------
 # EIP-2537
 # ---------------------------------------------------------------------------

@@ -493,6 +493,71 @@ def test_newpayload_v4_executionrequests_validation():
     assert "blockHash mismatch" in body["result"]["validationError"]
 
 
+def test_newpayload_fork_timestamp_rule_returns_38005():
+    """Engine API 'Unsupported fork' rule: V3 serves exactly the Cancun
+    window and V4 exactly Prague — a timestamp on either side of the
+    window returns -38005 before any processing, in both directions."""
+    from phant_tpu.config import ChainConfig
+    from phant_tpu.engine_api import UNSUPPORTED_FORK_CODE
+
+    cfg = ChainConfig(
+        ChainName="forktest",
+        chainId=int(ChainId.Testing),
+        cancunTime=1000,
+        pragueTime=2000,
+        osakaTime=3000,
+    )
+    chain = Blockchain(
+        chain_id=int(ChainId.Testing),
+        state=StateDB(),
+        parent_header=make_genesis_parent_header(),
+        verify_state_root=False,
+        config=cfg,
+    )
+    beacon = bytes_to_hex(b"\x5b" * 32)
+
+    def v3_req(ts: int) -> dict:
+        params = _valid_payload_json()
+        params["timestamp"] = hex(ts)
+        params["blobGasUsed"] = "0x0"
+        params["excessBlobGas"] = "0x0"
+        return {
+            "jsonrpc": "2.0",
+            "id": 21,
+            "method": "engine_newPayloadV3",
+            "params": [params, [], beacon],
+        }
+
+    def v4_req(ts: int) -> dict:
+        req = v3_req(ts)
+        return {**req, "method": "engine_newPayloadV4",
+                "params": req["params"] + [[]]}
+
+    # V3 below Cancun and at/after Prague: both directions unsupported
+    for ts in (999, 2000):
+        http, body = handle_request(chain, v3_req(ts))
+        assert http == 200
+        assert body["error"]["code"] == UNSUPPORTED_FORK_CODE, (ts, body)
+        assert body["error"]["message"] == "Unsupported fork"
+    # V3 inside the Cancun window processes normally (no -38005; this
+    # payload's parent disagrees with the fork schedule, so execution may
+    # report INVALID — the point is the fork gate let it through)
+    _http, body = handle_request(chain, v3_req(1500))
+    assert "result" in body, body
+
+    # V4 below Prague and at/after Osaka: both directions unsupported
+    for ts in (1500, 3000):
+        http, body = handle_request(chain, v4_req(ts))
+        assert http == 200
+        assert body["error"]["code"] == UNSUPPORTED_FORK_CODE, (ts, body)
+    _http, body = handle_request(chain, v4_req(2500))
+    assert "result" in body, body
+
+    # config-less fixture chains skip the rule entirely
+    _http, body = handle_request(_fresh_chain(), v3_req(1))
+    assert "error" not in body or body["error"]["code"] != UNSUPPORTED_FORK_CODE
+
+
 def test_consensus_data_unavailable_propagates(evm_backend_cpu):
     """A Prague block calling the gated map-to-curve precompile must abort
     validation loudly (not fake a post-state) on BOTH EVM backends — on

@@ -1098,3 +1098,295 @@ def test_busy_integration_is_in_hostsync_scope(mutated_tree, monkeypatch):
     hits = [f for f in res.new if f.rule == "HOSTSYNC" and ".item()" in f.message]
     assert hits, [f.render() for f in res.new]
     assert any("scheduler" in f.path for f in hits)
+
+
+# ---------------------------------------------------------------------------
+# Concurrency analysis v2: LOCKORDER / LOCKBLOCK / THREADSHARE + LOCK L2
+# ---------------------------------------------------------------------------
+
+from phant_tpu.analysis.rules.lockblock import LockBlockRule
+from phant_tpu.analysis.rules.lockorder import LockOrderRule
+from phant_tpu.analysis.rules.threadshare import ThreadShareRule
+
+DEADLOCK_SRC = '''
+import threading
+
+_A = threading.Lock()
+_B = threading.Lock()
+
+def takes_b():
+    with _B:
+        pass
+
+def ab_path():
+    with _A:
+        takes_b()   # interprocedural edge A -> B
+
+def ba_path():
+    with _B:
+        with _A:    # lexical edge B -> A: closes the cycle
+            pass
+
+def consistent():
+    with _A:
+        with _B:    # same order as ab_path: no NEW cycle
+            pass
+'''
+
+
+def test_lockorder_flags_ab_ba_cycle(tmp_path, monkeypatch):
+    res = run_fixture(
+        tmp_path, monkeypatch, {"dl.py": DEADLOCK_SRC}, [LockOrderRule()]
+    )
+    msgs = [f.message for f in res.new]
+    assert len(msgs) == 1, msgs  # one finding per cycle, not per edge
+    assert "lock-order cycle" in msgs[0]
+    assert "pkg.dl._A" in msgs[0] and "pkg.dl._B" in msgs[0]
+    # both witness directions are in the report
+    assert "ab_path" in msgs[0] and "ba_path" in msgs[0]
+
+
+def test_lockorder_self_reacquire_and_instance_conflation(tmp_path, monkeypatch):
+    src = '''
+import threading
+
+class Box:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._rlock = threading.RLock()
+
+    def deadlocks_itself(self):
+        with self._lock:
+            with self._lock:   # non-reentrant: single-thread deadlock
+                pass
+
+    def reentrant_ok(self):
+        with self._rlock:
+            with self._rlock:  # RLock: legal by design
+                pass
+
+    def sibling_call(self, other):
+        with self._lock:
+            other.touch()      # same STATIC id, different instance: skip
+
+    def touch(self):
+        with self._lock:
+            pass
+'''
+    res = run_fixture(tmp_path, monkeypatch, {"box.py": src}, [LockOrderRule()])
+    msgs = [f.message for f in res.new]
+    assert len(msgs) == 1, msgs
+    assert "re-acquiring non-reentrant lock" in msgs[0]
+    assert "deadlocks" in msgs[0]
+
+
+BLOCKING_SRC = '''
+import queue
+import subprocess
+import threading
+import time
+
+class Lane:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._q = queue.SimpleQueue()
+        self._fut = None
+
+    def convoy(self):
+        with self._lock:
+            return self._fut.result()   # blocks every waiter on _lock
+
+    def drains_queue(self):
+        with self._lock:
+            return self._q.get()        # typed receiver: queue get under lock
+
+    def waits_ok(self):
+        with self._lock:
+            self._cond.wait()           # Condition.wait RELEASES the lock
+
+    def indirect(self):
+        with self._lock:
+            self._helper()              # closure blocks: flagged at this call
+
+    def _helper(self):
+        time.sleep(0.1)
+
+    def callee_decided(self):
+        with self._lock:
+            build()     # build() guards its own blocking op: NOT re-flagged
+
+    def clean(self):
+        with self._lock:
+            self._fut = None
+        return self._q.get()            # outside the lock: fine
+
+_b_lock = threading.Lock()
+
+def build():
+    with _b_lock:
+        subprocess.run(["true"])        # guarded at its own site: one finding
+'''
+
+
+def test_lockblock_direct_and_interprocedural(tmp_path, monkeypatch):
+    res = run_fixture(
+        tmp_path, monkeypatch, {"lane.py": BLOCKING_SRC}, [LockBlockRule()]
+    )
+    by_ctx = {}
+    for f in res.new:
+        by_ctx.setdefault(f.context, []).append(f.message)
+    assert any("Future.result()" in m for m in by_ctx.get("pkg.lane.Lane.convoy", [])), by_ctx
+    assert any("queue get()" in m for m in by_ctx.get("pkg.lane.Lane.drains_queue", []))
+    # interprocedural: the lock-held call names the inner blocking op
+    assert any(
+        "time.sleep()" in m and "_helper" in m
+        for m in by_ctx.get("pkg.lane.Lane.indirect", [])
+    ), by_ctx
+    # the guarded subprocess.run is build()'s single finding...
+    assert any("subprocess.run()" in m for m in by_ctx.get("pkg.lane.build", []))
+    # ...and is NOT propagated to the caller holding another lock
+    assert "pkg.lane.Lane.callee_decided" not in by_ctx, by_ctx
+    # Condition.wait and the unlocked get are clean
+    assert "pkg.lane.Lane.waits_ok" not in by_ctx
+    assert "pkg.lane.Lane.clean" not in by_ctx
+
+
+THREADSHARE_SRC = '''
+import threading
+
+class Worker:
+    def __init__(self):
+        self.state = 0
+        self._t = None
+
+    def start(self):
+        self._t = threading.Thread(target=self._run, daemon=True)
+        self._t.start()
+
+    def _run(self):
+        self.state += 1      # visible to spawner AND worker, no lock
+
+class LockedWorker:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.state = 0
+
+    def start(self):
+        threading.Thread(target=self._run, daemon=True).start()
+
+    def _run(self):
+        with self._lock:
+            self.state += 1
+
+# phantlint: immutable — counters only move forward, torn reads benign
+class WaivedWorker:
+    def __init__(self):
+        self.state = 0
+
+    def start(self):
+        threading.Thread(target=self._run, daemon=True).start()
+
+    def _run(self):
+        self.state += 1
+
+class Registry:
+    def __init__(self):
+        self.items = {}
+
+    def add(self, k, v):
+        self.items = {**self.items, k: v}
+
+REG = Registry()   # module-level singleton: every importing thread shares it
+
+class Unshared:
+    def __init__(self):
+        self.n = 0
+
+    def bump(self):
+        self.n += 1          # never crosses a thread: not flagged
+'''
+
+
+def test_threadshare_flags_lockless_shared_classes(tmp_path, monkeypatch):
+    res = run_fixture(
+        tmp_path, monkeypatch, {"ws.py": THREADSHARE_SRC}, [ThreadShareRule()]
+    )
+    ctxs = sorted(f.context for f in res.new)
+    assert ctxs == ["pkg.ws.Registry", "pkg.ws.Worker"], ctxs
+    reg = next(f for f in res.new if f.context == "pkg.ws.Registry")
+    assert "module-level singleton" in reg.message
+    wrk = next(f for f in res.new if f.context == "pkg.ws.Worker")
+    assert "threading.Thread" in wrk.message and "state" in wrk.message
+
+
+def test_lock_l2_resolves_real_lock_objects(tmp_path, monkeypatch):
+    # Pre-tightening, ANY context manager whose dotted name contained
+    # "lock" suppressed the lazy-init finding. Now only a resolvable
+    # threading.Lock/RLock object does.
+    src = '''
+import contextlib
+import threading
+
+_REAL = threading.Lock()
+_MEMO = None
+_MEMO2 = None
+
+@contextlib.contextmanager
+def lockdown():
+    yield   # named like a lock; is not one
+
+def racy_memo():
+    global _MEMO
+    if _MEMO is None:
+        with lockdown():
+            _MEMO = object()
+    return _MEMO
+
+def safe_memo():
+    global _MEMO2
+    if _MEMO2 is None:
+        with _REAL:
+            _MEMO2 = object()
+    return _MEMO2
+'''
+    res = run_fixture(tmp_path, monkeypatch, {"memo.py": src}, [LockRule()])
+    ctxs = [f.context for f in res.new]
+    assert ctxs == ["pkg.memo.racy_memo"], ctxs
+
+
+def test_flightrecorder_dump_capacity_regression(tmp_path, monkeypatch):
+    # The original (pre-PR-16) FlightRecorder.dump read `self.capacity`
+    # outside `self._lock` while resize() rebuilt the ring and wrote
+    # capacity under it — a dump racing a resize could stamp the payload
+    # with a capacity the ring never had. LOCK must keep flagging the
+    # shape so it cannot come back.
+    src = '''
+import threading
+from collections import deque
+
+class FlightRecorder:
+    def __init__(self, capacity=512):
+        self._lock = threading.Lock()
+        self.capacity = capacity
+        self._ring = deque(maxlen=capacity)
+        self._dump_seq = 0
+
+    def resize(self, capacity):
+        with self._lock:
+            self.capacity = capacity
+            self._ring = deque(self._ring, maxlen=capacity)
+
+    def dump(self, reason):
+        payload = {
+            "reason": reason,
+            "capacity": self.capacity,   # racy read: resize() writes under _lock
+        }
+        with self._lock:
+            self._dump_seq += 1
+        return payload
+'''
+    res = run_fixture(tmp_path, monkeypatch, {"fr.py": src}, [LockRule()])
+    hits = [f for f in res.new if "capacity" in f.message]
+    assert hits, [f.message for f in res.new]
+    assert any(f.context == "pkg.fr.FlightRecorder.dump" for f in hits)

@@ -23,6 +23,7 @@ both pipeline depths.
 from __future__ import annotations
 
 import json
+import os
 import threading
 import time
 import urllib.error
@@ -129,6 +130,12 @@ def test_verify_many_rejects_bad_witnesses_per_request():
     assert out[[i for i in range(16) if i not in (3, 9)]].all()
 
 
+@pytest.mark.skipif(
+    os.environ.get("PHANT_SANITIZE") == "1",
+    reason="batching efficacy is a timing bar: phantsan's instrumented "
+    "locks slow the submit loop, so the assembly window catches fewer "
+    "requests — a perf assertion under a sanitizer measures the sanitizer",
+)
 def test_batching_efficacy_64_plus_requests_mean_batch_over_8():
     """The acceptance bar: >=64 concurrent requests through the scheduler,
     mean engine batch > 8, results identical to serial execution."""
