@@ -93,6 +93,14 @@ DEFAULT_ENTRIES: Tuple[str, ...] = (
     # per-block witness generation loop
     "phant_tpu.commitment.binary.BinaryScheme.collect_nodes",
     "phant_tpu.commitment.binary.BinaryScheme.proof_nodes",
+    # historical replay (PR 18): segment plan lowering runs on the
+    # replay pipeline's prefetch stage — it groups K blocks' root plans
+    # into structure-sharing runs and stacks the payload blobs for ONE
+    # vmapped device program, all host-side shape work by design; a
+    # reintroduced `.item()`/readback there re-serializes segment N+1's
+    # prep against segment N's device work (the resolve stage's honest
+    # per-root readback lives in resolve_segment_roots, off this list)
+    "phant_tpu.replay.lowering.lower_segment_plans",
 )
 
 _SCALAR_BUILTINS = ("int", "bool", "float")

@@ -138,11 +138,25 @@ class Blockchain:
         per-dispatch latency is amortized over hundreds of txs. The
         reference's import loop is strictly serial per tx
         (reference: src/blockchain/blockchain.zig:61-96, :241); the batching
-        axis across blocks is this framework's north-star addition."""
+        axis across blocks is this framework's north-star addition.
+
+        When a scheduler sig lane is installed (stateless.
+        sender_lane_available), the SAME window pipeline engages even
+        without a device: each window's rows go through
+        `dispatch_sender_recovery`, so the rows are built once per WINDOW
+        and the fused recovery runs on the scheduler's executor threads
+        under the EVM's feet. Before the r18 fix this path fell through
+        to the plain loop and paid a per-block signing-hash + recovery on
+        the critical path with the lane sitting idle."""
         from phant_tpu.backend import crypto_backend, jax_device_ok
+        from phant_tpu.stateless import (
+            dispatch_sender_recovery,
+            sender_lane_available,
+        )
 
         results = []
-        if not (crypto_backend() == "tpu" and jax_device_ok()):
+        lane = sender_lane_available()
+        if not lane and not (crypto_backend() == "tpu" and jax_device_ok()):
             for block in blocks:
                 results.append(self.run_block(block, check_body_roots))
             return results
@@ -163,6 +177,16 @@ class Blockchain:
         def dispatch(span):
             s, e = span
             txs = [tx for b in blocks[s:e] for tx in b.transactions]
+            if lane:
+                # route the whole window through the sig lane: rows are
+                # built once here and recovery runs on the scheduler's
+                # executor threads; a shed/crashed lane degrades inside
+                # the returned resolve (dispatch_sender_recovery), and a
+                # lane that went away between windows falls through to
+                # the direct dispatch below
+                handle = dispatch_sender_recovery(self.chain_id, txs)
+                if handle is not None:
+                    return handle
             try:
                 return self.signer.recover_senders_async(txs)
             except Exception as exc:  # staging onto a dead device can raise

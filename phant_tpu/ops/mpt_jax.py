@@ -577,6 +577,30 @@ def _hash_plans_batched(blobs, levels, *, max_chunks: int):
     )(blobs)
 
 
+def plans_share_structure(a: HashPlan, b: HashPlan) -> bool:
+    """True when two plans have identical level layouts (offsets, lengths,
+    hole positions, hole children) and blob sizes — the precondition for
+    vmapping them through one `_hash_plans_batched` dispatch. Consecutive
+    block states of the same account trie share structure whenever only
+    fixed-width leaf values changed; an account birth/death or a
+    variable-width RLP growth breaks the run. The replay segment lowerer
+    (phant_tpu/replay/lowering.py) uses this to group a segment's
+    per-block plans into maximal batchable runs instead of failing the
+    whole segment on the first mismatch."""
+    if len(a.blob) != len(b.blob) or len(a.levels) != len(b.levels):
+        return False
+    for (o1, l1, h1, c1), (o2, l2, h2, c2) in zip(a.levels, b.levels):
+        if (
+            o1.shape != o2.shape
+            or not np.array_equal(o1, o2)
+            or not np.array_equal(l1, l2)
+            or not np.array_equal(h1, h2)
+            or not np.array_equal(c1, c2)
+        ):
+            return False
+    return True
+
+
 def trie_roots_device_batched(plans: List[HashPlan]) -> List[bytes]:
     """Roots for K same-structure plans (identical level layouts, differing
     blobs) in one fused device dispatch. Raises ValueError if the plans'
@@ -586,17 +610,8 @@ def trie_roots_device_batched(plans: List[HashPlan]) -> List[bytes]:
         return []
     ref = plans[0]
     for p in plans[1:]:
-        if len(p.blob) != len(ref.blob) or len(p.levels) != len(ref.levels):
+        if not plans_share_structure(p, ref):
             raise ValueError("batched plans must share structure")
-        for (o1, l1, h1, c1), (o2, l2, h2, c2) in zip(p.levels, ref.levels):
-            if (
-                o1.shape != o2.shape
-                or not np.array_equal(o1, o2)
-                or not np.array_equal(l1, l2)
-                or not np.array_equal(h1, h2)
-                or not np.array_equal(c1, c2)
-            ):
-                raise ValueError("batched plans must share structure")
     blobs = jnp.asarray(np.stack([p.blob for p in plans]))
     # per-LEVEL metadata uploads, bounded by trie depth (~8 tiny arrays) —
     # not a data-axis loop; the node axis itself ships in the one blob above

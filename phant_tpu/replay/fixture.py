@@ -1,0 +1,132 @@
+"""Replay fixture chains — the on-disk unit `python -m phant_tpu.replay`
+consumes.
+
+A fixture is a pickled dict carrying a genesis header, the genesis
+account set, and an ordered block list (the same picklable shapes
+bench.py's `_build_replay_chain` caches), optionally enriched with
+per-block witnesses: `(claimed_root, nodes)` pairs generated against
+each block's PARENT state under a named commitment scheme
+(phant_tpu/commitment/). Witnessed fixtures let the replay engine drive
+segment ingestion through the scheduler's witness lane — K blocks'
+linked-multiproof checks coalescing into megabatches — in addition to
+the sig/root megabatches an unwitnessed fixture already exercises.
+"""
+
+from __future__ import annotations
+
+import pickle
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Tuple
+
+FORMAT = "phant-replay-fixture"
+VERSION = 1
+
+
+@dataclass
+class ReplayFixture:
+    """One replayable chain segment: genesis + blocks (+ witnesses)."""
+
+    chain_id: int
+    genesis: object  # types.block.BlockHeader
+    genesis_accounts: Dict[bytes, object]  # address -> types.account.Account
+    blocks: List[object]  # types.block.Block, ascending
+    #: per-block (claimed_root, nodes) against the PARENT state, or None
+    witnesses: Optional[List[Tuple[bytes, List[bytes]]]] = None
+    #: commitment scheme the witnesses were generated under
+    scheme: Optional[str] = None
+
+    def fresh_state(self):
+        from phant_tpu.state.statedb import StateDB
+
+        return StateDB(
+            {a: acct.copy() for a, acct in self.genesis_accounts.items()}
+        )
+
+    def fresh_chain(self, verify_state_root: bool = True):
+        from phant_tpu.blockchain.chain import Blockchain
+
+        return Blockchain(
+            self.chain_id,
+            self.fresh_state(),
+            self.genesis,
+            verify_state_root=verify_state_root,
+        )
+
+    @property
+    def total_txs(self) -> int:
+        return sum(len(b.transactions) for b in self.blocks)
+
+
+def from_bench_tuple(built: tuple, chain_id: int = 1) -> ReplayFixture:
+    """Adapt bench.py's `_build_replay_chain` cache tuple
+    `(genesis, blocks, genesis_accounts, total_txs, n_calls)` — the one
+    synthetic-chain builder in the tree stays the one in bench.py."""
+    genesis, blocks, genesis_accounts, _total_txs, _n_calls = built
+    return ReplayFixture(
+        chain_id=chain_id,
+        genesis=genesis,
+        genesis_accounts=genesis_accounts,
+        blocks=list(blocks),
+    )
+
+
+def attach_witnesses(fix: ReplayFixture, scheme=None) -> ReplayFixture:
+    """Enrich a fixture with per-block full-state witnesses under
+    `scheme` (default: the active PHANT_COMMITMENT scheme). Each block's
+    claimed root commits its PARENT state — under the hexary mpt scheme
+    that is byte-identical to the parent header's state_root; the binary
+    scheme's roots are its own (the header chain stays hexary, the
+    witness lane only checks linkage against the claimed root). Builds
+    by replaying on a throwaway chain; O(blocks x state), fixture-prep
+    cost, never on a replay path."""
+    from phant_tpu.commitment import active_scheme
+
+    sch = scheme if scheme is not None else active_scheme()
+    chain = fix.fresh_chain(verify_state_root=False)
+    witnesses: List[Tuple[bytes, List[bytes]]] = []
+    for block in fix.blocks:
+        root, nodes, _codes = sch.witness_of_state(chain.state.accounts)
+        witnesses.append((root, list(nodes)))
+        chain.run_block(block)
+    fix.witnesses = witnesses
+    fix.scheme = sch.name
+    return fix
+
+
+def save_fixture(path: str, fix: ReplayFixture) -> None:
+    payload = {
+        "format": FORMAT,
+        "version": VERSION,
+        "chain_id": fix.chain_id,
+        "genesis": fix.genesis,
+        "genesis_accounts": fix.genesis_accounts,
+        "blocks": fix.blocks,
+        "witnesses": fix.witnesses,
+        "scheme": fix.scheme,
+    }
+    with open(path, "wb") as f:
+        pickle.dump(payload, f)
+
+
+def load_fixture(path: str) -> ReplayFixture:
+    """Load a fixture file; the raw bench `_build_replay_chain` tuple is
+    accepted too (a cached bench chain replays as-is)."""
+    with open(path, "rb") as f:
+        payload = pickle.load(f)
+    if isinstance(payload, tuple):
+        return from_bench_tuple(payload)
+    if not isinstance(payload, dict) or payload.get("format") != FORMAT:
+        raise ValueError(f"{path}: not a {FORMAT} file")
+    if payload.get("version") != VERSION:
+        raise ValueError(
+            f"{path}: fixture version {payload.get('version')!r} "
+            f"(supported: {VERSION})"
+        )
+    return ReplayFixture(
+        chain_id=payload["chain_id"],
+        genesis=payload["genesis"],
+        genesis_accounts=payload["genesis_accounts"],
+        blocks=list(payload["blocks"]),
+        witnesses=payload.get("witnesses"),
+        scheme=payload.get("scheme"),
+    )

@@ -869,3 +869,19 @@ class MeshExecutorPool:
     def engines(self) -> list:
         """The per-lane engines (tests assert lease accounting on them)."""
         return list(self._engines)
+
+    def lane_engines(self, kind: str = "witness") -> list:
+        """Per-lane engine snapshot by lane kind: "witness" = the pinned
+        WitnessEngines (always built), "root"/"sig" = the lazily-built
+        pinned RootEngines/SigEngines with None for lanes whose first
+        batch of that kind hasn't arrived. Replay's mesh fan-out test
+        reads this to assert per-lane RESIDENT intern tables — segments
+        sharded across lanes must populate each lane's own engine, not
+        funnel through a shared one."""
+        if kind == "witness":
+            return list(self._engines)
+        if kind == "root":
+            return list(self._root_engines)
+        if kind == "sig":
+            return list(self._sig_engines)
+        raise ValueError(f"unknown lane kind {kind!r}")

@@ -1038,6 +1038,23 @@ class VerificationScheduler:
         lifecycle, so the answer is the same."""
         return self.accepts_witness()
 
+    def sig_backlog(self) -> int:
+        """Signature ROWS currently queued on the sig lane (txs, not
+        jobs — sig jobs coalesce freely, so rows are the unit of queued
+        device work). The replay engine's lookahead pacer
+        (phant_tpu/replay/engine.py) holds segment N+1's dispatch while
+        the lane still has more than a segment's worth of rows queued,
+        so a deep replay pipeline cannot monopolize the admission queue
+        it shares with live serving traffic — the root twin is
+        root_backlog (the lone-request guard's company signal)."""
+        with self._lock:
+            return sum(
+                j.nbytes
+                for lane in self._lanes.values()
+                for j in lane
+                if j.kind == _SIG
+            )
+
     def _resolve_sig_engine(self):
         factory = self.config.sig_engine_factory  # outside the lock, as above
         with self._engine_lock:

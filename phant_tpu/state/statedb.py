@@ -371,9 +371,15 @@ class StateDB:
                 trie.put(key, rlp.encode(rlp.encode_uint(value)))
         return trie.root_hash()
 
-    def state_root(self) -> bytes:
+    def flush_root_trie(self):
+        """Apply every dirty account to the retained state trie WITHOUT
+        hashing it, and return the trie. `state_root()` is flush + host
+        `root_hash()`; the replay engine's deferred-root mode flushes per
+        block, builds a HashPlan from the unhashed trie, and hashes K
+        consecutive block states on device in ONE vmapped dispatch
+        (phant_tpu/replay/lowering.py) — the flush/hash split is what lets
+        the hashing leave the per-block critical path."""
         from phant_tpu.crypto.keccak import keccak256
-        from phant_tpu import rlp
         from phant_tpu.state.root import build_state_trie
 
         if self._root_trie is None:
@@ -404,12 +410,16 @@ class StateDB:
                     )
                     self._root_trie.put(key, leaf)
         self._root_dirty.clear()
+        return self._root_trie
+
+    def state_root(self) -> bytes:
         # host recursion on purpose, even on --crypto_backend=tpu: the
         # retained trie re-encodes only dirty paths (per-path enc cache),
         # which beats shipping a full plan rebuild to the device every
         # block; the device state-root path serves FULL recomputes (the
-        # stateless witness pipeline), not incremental resident updates
-        return self._root_trie.root_hash()
+        # stateless witness pipeline and the replay engine's deferred
+        # segment roots), not incremental resident updates
+        return self.flush_root_trie().root_hash()
 
     def copy(self) -> "StateDB":
         return StateDB({a: acct.copy() for a, acct in self.accounts.items()})

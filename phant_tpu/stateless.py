@@ -879,7 +879,22 @@ def _request_signer(chain_id: int):
     return signer
 
 
-def dispatch_sender_recovery(chain_id: int, txs):
+def sender_lane_available() -> bool:
+    """Cheap 'is the sig lane in play for this thread right now'
+    pre-filter: `_batched_sig_wanted()` plus a live installed scheduler
+    that accepts sig work. `run_blocks`' window prefetch and the replay
+    engine consult this ONCE per import/segment instead of paying a
+    dispatch_sender_recovery round-trip per block to find out the lane
+    is off."""
+    if not _batched_sig_wanted():
+        return False
+    from phant_tpu.serving import active_scheduler
+
+    sched = active_scheduler()
+    return sched is not None and sched.accepts_sig()
+
+
+def dispatch_sender_recovery(chain_id: int, txs, rows=None):
     """Dispatch one block's sender recovery through the active
     scheduler's sig lane; returns `resolve() -> senders`, or None when
     the lane is not in play (no scheduler, `_batched_sig_wanted()`
@@ -902,7 +917,14 @@ def dispatch_sender_recovery(chain_id: int, txs):
 
     The resolve-side block time is exported as `sched.sig_wait` — the
     part of the recovery that did NOT hide under witness verification
-    (the overlap audit, same reading as `sched.prefetch_wait`)."""
+    (the overlap audit, same reading as `sched.prefetch_wait`).
+
+    `rows=` optionally supplies PRE-BUILT signature rows for the same
+    txs: the replay engine's prefetch worker builds a whole segment's
+    merged rows off the critical path (under `replay.prefetch`) and
+    hands them here so the signing-hash pass isn't repeated at dispatch
+    time; `run_blocks`' window prefetch passes txs and lets this build
+    them (one pass per WINDOW, not per block — the r18 bugfix)."""
     if not txs or not _batched_sig_wanted():
         return None
     from phant_tpu.serving import active_scheduler
@@ -916,8 +938,9 @@ def dispatch_sender_recovery(chain_id: int, txs):
     from phant_tpu.utils.trace import metrics
 
     signer = _request_signer(chain_id)
-    with metrics.phase("stateless.sig_rows"):
-        rows = signer.signature_rows(list(txs))
+    if rows is None:
+        with metrics.phase("stateless.sig_rows"):
+            rows = signer.signature_rows(list(txs))
 
     def degrade():
         # shed/crashed lane: recover from the rows ALREADY built (no

@@ -297,6 +297,22 @@ METRIC_HELP: Dict[str, str] = {
     "commitment.witness_nodes": "Witness nodes generated by full-state witness collection (spec runner / differential harnesses), by scheme",
     "commitment.translated_fixtures": "Spec fixtures re-committed under an alternate commitment scheme (commitment/translate.py)",
     "commitment.translated_blocks": "Fixture blocks re-sealed with alternate-scheme state roots during fixture translation",
+    # historical replay engine (phant_tpu/replay/)
+    "replay.segments": "Chain segments imported by the replay engine (prefetch/pack/dispatch/resolve pipeline turns)",
+    "replay.blocks": "Blocks successfully imported by the replay engine",
+    "replay.txs": "Transactions imported by the replay engine (the merged-ecrecover row volume)",
+    "replay.block_failures": "Consensus-invalid blocks that stopped a replay (the replay.block_failed flight record carries the attribution)",
+    "replay.lane_fallbacks": "Segments degraded to a local megabatch after a scheduler-lane failure, by stage (prefetch/pack/dispatch/resolve; -32052 in-flight-only semantics)",
+    "replay.root_groups": "Deferred segment-root groups resolved, by backend (device = one vmapped _hash_plans_batched program per structure-sharing run; host = singleton/unplannable walks)",
+    "replay.prefetch": "Replay prefetch stage: building segment N+1's merged signature rows (host keccak over RLP) under segment N's EVM execution",
+    "replay.pack": "Replay pack stage: submitting segment N+1's witness megabatch to the witness lane",
+    "replay.dispatch": "Replay dispatch stage: launching segment N+1's merged ecrecover on the sig lane (incl. the sig-backlog pacing wait)",
+    "replay.sig_wait": "Replay blocks joining a segment's merged senders at execute time — recovery cost that did NOT hide under the previous segment's EVM (the overlap audit)",
+    "replay.witness_wait": "Replay blocks joining a segment's witness verdicts at execute time",
+    "replay.root_wait": "Deferred segment-root lowering + readback at segment end (the one root sync per segment)",
+    "replay.segment_seconds": "Whole-segment resolve+execute wall clock (the blocks/s denominator at segment granularity)",
+    "replay.segment_blocks": "Configured blocks per replay segment (--segment)",
+    "replay.pipeline_depth": "Configured replay pipeline depth (1 = fully inline; >= 2 = segment N+1 prepared under segment N's execution)",
     # crypto backend dispatch
     "keccak.batches": "Batched keccak dispatches by backend",
     "keccak.bytes": "Payload bytes submitted to batched keccak by backend",
@@ -329,6 +345,8 @@ SPAN_HELP: Dict[str, str] = {
     "obs.slow_capture": "A request blew its SLO budget (--slo-budget-ms wall clock, or a per-phase env override): carries the FULL span tree plus the critical-path breakdown — metrics say THAT it was slow, this exemplar says WHY (served at /debug/slow)",
     "obs.profile": "An on-demand TPU profiler capture ran (POST /debug/profile): carries the trace directory, the captured window, and the artifact count",
     "obs.timeline_export": "A timeline export was rendered (GET /debug/timeline / spool): carries the window, event count, and how many requests/batches landed in it",
+    "replay.segment_crash": "A scheduler lane failed a replay segment's in-flight work (stage-named: prefetch/pack/dispatch/resolve; carries the SchedulerDown/-32052 code); the segment degraded to its local megabatch fallback and the import continued",
+    "replay.block_failed": "A consensus-invalid block stopped a replay import (stage-named; carries the block index/number and the BlockError text) — earlier blocks stand, run_blocks semantics",
 }
 
 

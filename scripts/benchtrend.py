@@ -94,7 +94,11 @@ def _direction(key: str) -> Optional[str]:
         # _per_sec suffix — pinned by test so a suffix rework cannot
         # silently drop the headline metric's direction; _vs_baseline:
         # the slope/baseline ratio itself (a shrinking ratio is the
-        # headline regressing even if both rates moved)
+        # headline regressing even if both rates moved);
+        # replay_sync_blocks_per_sec (round 18): the catch-up headline —
+        # segment-pipelined chain replay throughput (and its serial
+        # run_blocks echo `replay_sync_serial_blocks_per_sec`) both ride
+        # this suffix, pinned by test so a collapse in either leg flags
         return "up"
     if key.endswith("_hit_rate") or key.endswith("_hidden_pct"):
         # witness_stream (round 9): steady-state intern hit rate under
@@ -111,6 +115,10 @@ def _direction(key: str) -> Optional[str]:
         # paired COALESCING speedup — one merged dispatch vs K
         # per-request dispatches, backend held fixed — shrinking means
         # the coalesced dispatch is regressing toward per-request cost.
+        # replay_sync (round 18) rides the same rule: the paired
+        # segment-vs-serial replay margin (per-block dispatch/overhead
+        # amortization on the 1-core proxy) gates here, and a margin
+        # collapsing below its `replay_sync_noise_aa_pct` bar flags.
         # Each section's A/A noise bar (`_noise_aa_pct`), the honest
         # cross-backend echoes (`_vs_host_pct` / `_vs_native_pct`,
         # NEGATIVE on the shared-core proxy by construction — the

@@ -37,6 +37,13 @@ executeStatelessPayloadV1 — and asserts the obs postmortem contract:
     batch's trace ids (joinable to the HTTP X-Phant-Trace header);
   * `/healthz` flips to 503 and the flip writes its own dump.
 
+A replay phase (`_replay_phase`, PR 18) drives a witnessed fixture
+chain through the segment-pipelined ReplayEngine against a live
+scheduler: byte-identity with serial `run_blocks` on the healthy lanes,
+then an induced mid-segment sig-dispatch crash that must degrade
+stage-by-stage (stage-named `replay.segment_crash`, -32052, final root
+unchanged).
+
 The final phase (`_sanitizer_phase`, PR 17) re-runs a depth-2 pipelined
 scheduler under threaded submit pressure with the phantsan lockset race
 sanitizer (phant_tpu/analysis/sanitizer.py) enabled: instrumented lock
@@ -184,6 +191,9 @@ def main() -> int:
     if rc:
         return rc
     rc = _sender_lane_phase()
+    if rc:
+        return rc
+    rc = _replay_phase()
     if rc:
         return rc
     rc = _commitment_phase()
@@ -853,6 +863,159 @@ def _sender_lane_phase() -> int:
         "byte-identical (invalid-sig + pre-EIP-155 blocks included), "
         "induced sig-dispatch crash fails only in-flight with a "
         "stage-named dump"
+    )
+    return 0
+
+
+def _replay_phase() -> int:
+    """Historical replay soak (PR 18): a witnessed fixture chain through
+    the segment pipeline against a live depth-2 scheduler (sig + witness
+    lanes up) must land byte-identical to serial `run_blocks` with every
+    segment's merged ecrecover on the sig lane; then an induced
+    MID-SEGMENT sig-dispatch crash must degrade stage-by-stage — the
+    replay still completes on its local megabatch fallbacks, the final
+    state root does not change by a byte, and the flight recorder
+    carries stage-named `replay.segment_crash` records with the
+    scheduler's -32052 alongside the executor's own crash dump."""
+    import json
+
+    from phant_tpu import serving
+    from phant_tpu.obs.flight import flight
+    from phant_tpu.ops.sig_engine import SigEngine
+    from phant_tpu.ops.witness_engine import WitnessEngine
+    from phant_tpu.replay import (
+        ReplayEngine,
+        attach_witnesses,
+        from_bench_tuple,
+    )
+    from phant_tpu.replay.engine import (
+        STAGE_DISPATCH,
+        STAGE_PACK,
+        STAGE_PREFETCH,
+        STAGE_RESOLVE,
+    )
+
+    from bench import _build_replay_chain
+
+    failures: list = []
+    stages = (STAGE_PREFETCH, STAGE_PACK, STAGE_DISPATCH, STAGE_RESOLVE)
+    prev_sig = os.environ.get("PHANT_BATCHED_SIG")
+    os.environ["PHANT_BATCHED_SIG"] = "1"
+    try:
+        fix = attach_witnesses(
+            from_bench_tuple(_build_replay_chain(n_blocks=12, txs_per_block=3))
+        )
+        serial = fix.fresh_chain()
+        serial.run_blocks(fix.blocks)
+        want_root = serial.state.state_root()
+
+        def _sched(make_sig):
+            return serving.VerificationScheduler(
+                engine=WitnessEngine(),
+                config=serving.SchedulerConfig(
+                    max_batch=16,
+                    max_wait_ms=20.0,
+                    pipeline_depth=2,
+                    sig_engine_factory=make_sig,
+                ),
+            )
+
+        # healthy leg: byte-identity with every segment on the lanes
+        s = _sched(lambda: SigEngine(device_floor=0))
+        serving.install(s)
+        try:
+            rep = ReplayEngine(segment_blocks=5, pipeline_depth=2).run(
+                fix.fresh_chain(), fix.blocks, witnesses=fix.witnesses
+            )
+            st = s.stats_snapshot()
+        finally:
+            serving.uninstall(s)
+            s.shutdown()
+        if not rep.ok or rep.final_state_root != want_root:
+            failures.append("segment replay diverged from serial run_blocks")
+        if rep.stats["lane_sig_segments"] != rep.stats["segments"]:
+            failures.append(f"segment(s) skipped the sig lane: {rep.stats}")
+        if st["sig_batches"] < 1 or st["requests"] < 12:
+            failures.append(f"replay never rode the scheduler lanes: {st}")
+
+        # crash leg: the sig lane's dispatch dies mid-segment
+        class _PoisonedSig(SigEngine):
+            armed = True
+
+            def begin_batch(self, rows_list, prefetch=None):
+                if _PoisonedSig.armed:
+                    raise RuntimeError("soak-induced replay sig crash")
+                return super().begin_batch(rows_list, prefetch=prefetch)
+
+            def sig_many(self, rows_list):
+                if _PoisonedSig.armed:
+                    raise RuntimeError("soak-induced replay sig crash")
+                return super().sig_many(rows_list)
+
+        flight_dir = os.environ.get(
+            "PHANT_FLIGHT_DIR",
+            os.path.join(
+                os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                "build",
+                "flight",
+            ),
+        )
+        os.makedirs(flight_dir, exist_ok=True)
+        dumps_before = set(os.listdir(flight_dir))
+        before = len(flight.records())
+        s = _sched(_PoisonedSig)
+        serving.install(s)
+        try:
+            rep = ReplayEngine(segment_blocks=5, pipeline_depth=2).run(
+                fix.fresh_chain(), fix.blocks, witnesses=fix.witnesses
+            )
+        finally:
+            serving.uninstall(s)
+            s.shutdown()
+            _PoisonedSig.armed = False
+        if not rep.ok or rep.final_state_root != want_root:
+            failures.append("degraded replay changed the final state root")
+        recs = flight.records()[before:]
+        crashes = [
+            r for r in recs if r.get("kind") == "replay.segment_crash"
+        ]
+        if not crashes:
+            failures.append("no replay.segment_crash flight record")
+        else:
+            if not all(c.get("stage") in stages for c in crashes):
+                failures.append(f"segment crash lacks a stage name: {crashes}")
+            if not any(c.get("code") == -32052 for c in crashes):
+                failures.append(f"no -32052 on the segment crash: {crashes}")
+        crash_dumps = [
+            d
+            for d in sorted(set(os.listdir(flight_dir)) - dumps_before)
+            if "executor_crash" in d
+        ]
+        if not crash_dumps:
+            failures.append("no executor_crash flight dump from the sig lane")
+        else:
+            with open(os.path.join(flight_dir, crash_dumps[-1])) as f:
+                dump = json.load(f)  # must be well-formed JSON
+            if not any(
+                r.get("kind") == "sched.executor_crash"
+                for r in dump.get("records", [])
+            ):
+                failures.append("sig-lane dump lacks the executor crash record")
+    finally:
+        if prev_sig is None:
+            os.environ.pop("PHANT_BATCHED_SIG", None)
+        else:
+            os.environ["PHANT_BATCHED_SIG"] = prev_sig
+
+    if failures:
+        for f in failures:
+            print(f"[soak] FAIL (replay phase): {f}", file=sys.stderr)
+        return 1
+    print(
+        f"[soak] replay phase green: {rep.stats['segments']}-segment replay "
+        "byte-identical to serial on the lanes, induced mid-segment sig "
+        f"crash degraded stage-by-stage ({len(crashes)} segment-crash "
+        "records, root unchanged)"
     )
     return 0
 

@@ -569,3 +569,53 @@ def test_timeline_overhead_blowup_flags(tmp_path):
     _write_round(tmp_path, 4, {"timeline_overhead_pct": 24.0})
     rows, flags = benchtrend.analyze(str(tmp_path), 0.4, 2)
     assert any("timeline_overhead_pct" in f for f in flags)
+
+
+def test_replay_sync_key_directions():
+    """Round-18 `replay_sync` section keys: the catch-up throughput
+    headline and its serial run_blocks echo gate UP via `_per_sec`, and
+    the paired segment-vs-serial margin gates UP via `_speedup_pct`
+    (shrinking = per-block dispatch overhead creeping back into the
+    segment path); the A/A noise bar and the workload-shape echoes stay
+    informational. Pinned so a key rework cannot un-gate the PR 18
+    claims."""
+    d = benchtrend._direction
+    assert d("replay_sync_blocks_per_sec") == "up"
+    assert d("replay_sync_serial_blocks_per_sec") == "up"
+    assert d("replay_sync_segment_speedup_pct") == "up"
+    assert d("replay_sync_noise_aa_pct") is None
+    assert d("replay_sync_blocks") is None
+    assert d("replay_sync_txs_per_block") is None
+    assert d("replay_sync_segment_size") is None
+    assert d("replay_sync_pairs") is None
+    assert d("replay_sync_identity") is None
+
+
+def test_replay_sync_throughput_collapse_flags(tmp_path):
+    """A collapsed replay throughput must flag from a stable history —
+    catch-up regressing to a crawl is exactly the failure the megabatch
+    segment path exists to prevent — and so must the segment-vs-serial
+    margin going negative (the segment path landing SLOWER than the
+    serial loop it amortizes)."""
+    for n, (bps, sp) in enumerate(
+        [(290.0, 2.1), (301.0, 1.8), (296.0, 2.3)], start=1
+    ):
+        _write_round(
+            tmp_path,
+            n,
+            {
+                "replay_sync_blocks_per_sec": bps,
+                "replay_sync_segment_speedup_pct": sp,
+            },
+        )
+    _write_round(
+        tmp_path,
+        4,
+        {
+            "replay_sync_blocks_per_sec": 70.0,
+            "replay_sync_segment_speedup_pct": -9.0,
+        },
+    )
+    rows, flags = benchtrend.analyze(str(tmp_path), 0.4, 2)
+    assert any("replay_sync_blocks_per_sec" in f for f in flags)
+    assert any("replay_sync_segment_speedup_pct" in f for f in flags)

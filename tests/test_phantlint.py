@@ -1100,6 +1100,34 @@ def test_busy_integration_is_in_hostsync_scope(mutated_tree, monkeypatch):
     assert any("scheduler" in f.path for f in hits)
 
 
+def test_replay_lowering_is_in_hostsync_scope(mutated_tree, monkeypatch):
+    """The replay pipeline's prefetch-stage lowering (PR 18) is
+    HOSTSYNC-scoped: `lower_segment_plans` groups a segment's root plans
+    and enqueues the vmapped megabatch with ZERO host sync, and a stray
+    `.item()` reintroduced next to the blob stack turns the gate red
+    while the committed baseline stays EMPTY (the resolve stage's honest
+    per-root readback lives in resolve_segment_roots, off the list)."""
+    from phant_tpu.analysis.rules.hostsync import DEFAULT_ENTRIES
+
+    assert (
+        "phant_tpu.replay.lowering.lower_segment_plans" in DEFAULT_ENTRIES
+    )
+    p = mutated_tree / "phant_tpu" / "replay" / "lowering.py"
+    src = p.read_text()
+    mutated = src.replace(
+        "            blobs = jnp.asarray(",
+        "            _n = jnp.asarray(run[0].blob).sum().item()\n"
+        "            blobs = jnp.asarray(",
+        1,
+    )
+    assert mutated != src
+    p.write_text(mutated)
+    res = _analyze_repo_tree(mutated_tree, monkeypatch)
+    hits = [f for f in res.new if f.rule == "HOSTSYNC" and ".item()" in f.message]
+    assert hits, [f.render() for f in res.new]
+    assert any("replay" in f.path for f in hits)
+
+
 # ---------------------------------------------------------------------------
 # Concurrency analysis v2: LOCKORDER / LOCKBLOCK / THREADSHARE + LOCK L2
 # ---------------------------------------------------------------------------
